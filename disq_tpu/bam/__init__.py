@@ -1,0 +1,8 @@
+from disq_tpu.bam.header import SamHeader, SamSequence  # noqa: F401
+from disq_tpu.bam.columnar import ReadBatch  # noqa: F401
+from disq_tpu.bam.codec import (  # noqa: F401
+    decode_records,
+    encode_records,
+    scan_record_offsets,
+)
+from disq_tpu.bam.guesser import BamRecordGuesser  # noqa: F401

@@ -1,0 +1,218 @@
+"""Columnar alignment-record batch — the TPU-native record layout.
+
+Replaces htsjdk's per-record ``SAMRecord`` heap objects (SURVEY.md §2.8):
+a batch of N records is a struct-of-arrays with fixed-width columns plus
+ragged columns (name / CIGAR / seq / qual / tags) stored as flat arrays
+with ``(N+1,)`` offset vectors. Fixed columns map directly onto device
+arrays for masking/sorting/filtering on the VPU; ragged columns reorder
+via vectorized segment gathers.
+
+Sequence bases are stored *unpacked* (one 4-bit code per byte, values
+0–15, the BAM nibble alphabet ``=ACMGRSVTWYHKDBN``) — friendlier to
+vector compute than packed nibbles; packing back to BAM bytes happens in
+the encoder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields as dc_fields
+from typing import List, Sequence
+
+import numpy as np
+
+SEQ_NT16 = "=ACMGRSVTWYHKDBN"
+CIGAR_OPS = "MIDNSHP=X"
+
+
+def segment_gather(
+    flat: np.ndarray, offsets: np.ndarray, indices: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Gather ragged segments ``indices`` from (flat, offsets) into a new
+    (flat, offsets) pair. Fully vectorized (no per-record Python loop)."""
+    offsets = offsets.astype(np.int64)
+    lens = np.diff(offsets)[indices]
+    new_off = np.zeros(len(indices) + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_off[1:])
+    total = int(new_off[-1])
+    if total == 0:
+        return flat[:0].copy(), new_off
+    # within[k] = k - new_off[seg(k)]  (position inside its segment)
+    seg_ids = np.repeat(np.arange(len(indices)), lens)
+    within = np.arange(total, dtype=np.int64) - new_off[seg_ids]
+    src = offsets[indices][seg_ids] + within
+    return flat[src], new_off
+
+
+def _concat_ragged(
+    flats: Sequence[np.ndarray], offsets: Sequence[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
+    lens = [np.diff(o.astype(np.int64)) for o in offsets]
+    all_lens = np.concatenate(lens) if lens else np.zeros(0, np.int64)
+    new_off = np.zeros(len(all_lens) + 1, dtype=np.int64)
+    np.cumsum(all_lens, out=new_off[1:])
+    return (
+        np.concatenate([f for f in flats])
+        if flats
+        else np.zeros(0, np.uint8),
+        new_off,
+    )
+
+
+@dataclass
+class ReadBatch:
+    """N alignment records, struct-of-arrays.
+
+    Fixed columns (shape ``(N,)``):
+      ``refid`` i32, ``pos`` i32 (0-based), ``mapq`` u8, ``bin`` u16,
+      ``flag`` u16, ``next_refid`` i32, ``next_pos`` i32, ``tlen`` i32.
+    Ragged columns: ``names`` (bytes, no NUL) / ``cigars`` (u32 op-words)
+    / ``seqs`` (u8 nibble codes) / ``quals`` (u8) / ``tags`` (raw bytes),
+    each with its ``*_offsets`` vector of shape ``(N+1,)`` i64.
+    ``quals`` shares ``seq_offsets`` (same per-record length, l_seq).
+    """
+
+    refid: np.ndarray
+    pos: np.ndarray
+    mapq: np.ndarray
+    bin: np.ndarray
+    flag: np.ndarray
+    next_refid: np.ndarray
+    next_pos: np.ndarray
+    tlen: np.ndarray
+    name_offsets: np.ndarray
+    names: np.ndarray
+    cigar_offsets: np.ndarray
+    cigars: np.ndarray
+    seq_offsets: np.ndarray
+    seqs: np.ndarray
+    quals: np.ndarray
+    tag_offsets: np.ndarray
+    tags: np.ndarray
+
+    @property
+    def count(self) -> int:
+        return len(self.refid)
+
+    def __len__(self) -> int:
+        return self.count
+
+    @classmethod
+    def empty(cls) -> "ReadBatch":
+        z = lambda dt: np.zeros(0, dtype=dt)  # noqa: E731
+        off = np.zeros(1, dtype=np.int64)
+        return cls(
+            refid=z(np.int32), pos=z(np.int32), mapq=z(np.uint8),
+            bin=z(np.uint16), flag=z(np.uint16), next_refid=z(np.int32),
+            next_pos=z(np.int32), tlen=z(np.int32),
+            name_offsets=off.copy(), names=z(np.uint8),
+            cigar_offsets=off.copy(), cigars=z(np.uint32),
+            seq_offsets=off.copy(), seqs=z(np.uint8), quals=z(np.uint8),
+            tag_offsets=off.copy(), tags=z(np.uint8),
+        )
+
+    # -- reordering ---------------------------------------------------------
+
+    def take(self, indices: np.ndarray) -> "ReadBatch":
+        """Gather records by index — the primitive behind sort/filter."""
+        indices = np.asarray(indices, dtype=np.int64)
+        names, name_off = segment_gather(self.names, self.name_offsets, indices)
+        cigars, cigar_off = segment_gather(self.cigars, self.cigar_offsets, indices)
+        seqs, seq_off = segment_gather(self.seqs, self.seq_offsets, indices)
+        quals, _ = segment_gather(self.quals, self.seq_offsets, indices)
+        tags, tag_off = segment_gather(self.tags, self.tag_offsets, indices)
+        return ReadBatch(
+            refid=self.refid[indices], pos=self.pos[indices],
+            mapq=self.mapq[indices], bin=self.bin[indices],
+            flag=self.flag[indices], next_refid=self.next_refid[indices],
+            next_pos=self.next_pos[indices], tlen=self.tlen[indices],
+            name_offsets=name_off, names=names,
+            cigar_offsets=cigar_off, cigars=cigars,
+            seq_offsets=seq_off, seqs=seqs, quals=quals,
+            tag_offsets=tag_off, tags=tags,
+        )
+
+    def filter(self, mask: np.ndarray) -> "ReadBatch":
+        return self.take(np.nonzero(np.asarray(mask))[0])
+
+    def slice(self, start: int, stop: int) -> "ReadBatch":
+        return self.take(np.arange(start, stop, dtype=np.int64))
+
+    @classmethod
+    def concat(cls, batches: Sequence["ReadBatch"]) -> "ReadBatch":
+        batches = [b for b in batches]
+        if not batches:
+            return cls.empty()
+        if len(batches) == 1:
+            return batches[0]
+        names, name_off = _concat_ragged(
+            [b.names for b in batches], [b.name_offsets for b in batches]
+        )
+        cigars, cigar_off = _concat_ragged(
+            [b.cigars for b in batches], [b.cigar_offsets for b in batches]
+        )
+        seqs, seq_off = _concat_ragged(
+            [b.seqs for b in batches], [b.seq_offsets for b in batches]
+        )
+        quals, _ = _concat_ragged(
+            [b.quals for b in batches], [b.seq_offsets for b in batches]
+        )
+        tags, tag_off = _concat_ragged(
+            [b.tags for b in batches], [b.tag_offsets for b in batches]
+        )
+        cat = lambda attr: np.concatenate([getattr(b, attr) for b in batches])  # noqa: E731
+        return cls(
+            refid=cat("refid"), pos=cat("pos"), mapq=cat("mapq"),
+            bin=cat("bin"), flag=cat("flag"), next_refid=cat("next_refid"),
+            next_pos=cat("next_pos"), tlen=cat("tlen"),
+            name_offsets=name_off, names=names,
+            cigar_offsets=cigar_off, cigars=cigars,
+            seq_offsets=seq_off, seqs=seqs, quals=quals,
+            tag_offsets=tag_off, tags=tags,
+        )
+
+    # -- decoded views ------------------------------------------------------
+
+    def name(self, i: int) -> str:
+        s, e = self.name_offsets[i], self.name_offsets[i + 1]
+        return self.names[s:e].tobytes().decode()
+
+    def sequence(self, i: int) -> str:
+        s, e = self.seq_offsets[i], self.seq_offsets[i + 1]
+        return "".join(SEQ_NT16[c] for c in self.seqs[s:e])
+
+    def cigar_string(self, i: int) -> str:
+        s, e = self.cigar_offsets[i], self.cigar_offsets[i + 1]
+        ops = self.cigars[s:e]
+        if len(ops) == 0:
+            return "*"
+        return "".join(f"{int(op) >> 4}{CIGAR_OPS[int(op) & 0xF]}" for op in ops)
+
+    def qual_string(self, i: int) -> str:
+        s, e = self.seq_offsets[i], self.seq_offsets[i + 1]
+        q = self.quals[s:e]
+        if len(q) == 0 or (len(q) > 0 and q[0] == 0xFF):
+            return "*"
+        return "".join(chr(int(x) + 33) for x in q)
+
+    # Reference-consumed length on the genome, per record (vectorized):
+    # ops M/D/N/=/X (0,2,3,7,8) consume reference. Used by BAI binning
+    # and interval overlap.
+    def reference_lengths(self) -> np.ndarray:
+        op = (self.cigars & 0xF).astype(np.int64)
+        ln = (self.cigars >> 4).astype(np.int64)
+        consumes = np.isin(op, (0, 2, 3, 7, 8))
+        contrib = np.where(consumes, ln, 0)
+        sums = np.add.reduceat(
+            np.concatenate([contrib, [0]]),
+            np.minimum(self.cigar_offsets[:-1], len(contrib)),
+        ) if self.count else np.zeros(0, np.int64)
+        # reduceat quirk: empty segments (no cigar) produce the next
+        # element's value; mask them to 0.
+        empty = np.diff(self.cigar_offsets) == 0
+        sums = np.where(empty, 0, sums)
+        return sums
+
+    def alignment_ends(self) -> np.ndarray:
+        """0-based exclusive end positions (pos + reflen, min 1 consumed)."""
+        reflen = self.reference_lengths()
+        return self.pos + np.maximum(reflen, 1).astype(np.int32)

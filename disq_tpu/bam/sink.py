@@ -1,0 +1,180 @@
+"""BamSink — single-file and multi-file BAM write paths.
+
+Reference parity: ``impl/formats/bam/BamSink.java`` +
+``HeaderlessBamOutputFormat`` + ``AnySamSinkMultiple`` (SURVEY.md §2.4,
+call stack §3.3). Single-file protocol: shards write *headerless,
+terminatorless* BGZF parts to a temp dir, each emitting part-local BAI /
+SBI index fragments; the driver writes a header-only BGZF prefix,
+concatenates prefix + parts, appends the 28-byte terminator, and merges
+the index fragments by shifting each part's virtual offsets by its
+absolute start position.
+
+TPU-first twist: per-record virtual offsets inside a part are computed
+*vectorized* — the canonical BGZF blocking is deterministic (65280-byte
+payload per block), so ``voffset(u) = (block_comp_start[u // 65280] << 16)
+| (u % 65280)`` is array arithmetic over the record-offset vector, not a
+per-record stream query. This is what makes index construction a
+"segmented scan over sorted virtual offsets" (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from disq_tpu.api import (
+    BaiWriteOption,
+    SbiWriteOption,
+    TempPartsDirectoryWriteOption,
+    WriteOption,
+)
+from disq_tpu.bam.codec import encode_records, encode_records_with_offsets
+from disq_tpu.bam.columnar import ReadBatch
+from disq_tpu.bam.header import SamHeader
+from disq_tpu.bgzf.block import BGZF_EOF_MARKER, BGZF_MAX_PAYLOAD
+from disq_tpu.bgzf.codec import compress_to_bgzf, deflate_block
+from disq_tpu.fsw.filesystem import FileSystemWrapper, resolve_path
+from disq_tpu.index.bai import BaiIndex, build_bai, merge_bai_fragments
+from disq_tpu.index.sbi import SbiIndex
+
+SBI_GRANULARITY = 4096  # htsjdk SBIIndexWriter default
+
+
+def _opt_enabled(options: Sequence[WriteOption], cls, default: bool) -> bool:
+    for o in options:
+        if isinstance(o, cls):
+            return bool(o.value)
+    return default
+
+
+def bgzf_compress_with_voffsets(
+    blob: bytes, record_offsets: np.ndarray
+) -> Tuple[bytes, np.ndarray, np.ndarray]:
+    """Deflate ``blob`` into canonical BGZF (no terminator) and return
+    (compressed bytes, start voffsets, end voffsets) for the records whose
+    uncompressed offsets are ``record_offsets`` ((N+1,): starts + end)."""
+    comp_parts: List[bytes] = []
+    csizes = []
+    for i in range(0, len(blob), BGZF_MAX_PAYLOAD):
+        part = deflate_block(blob[i: i + BGZF_MAX_PAYLOAD])
+        comp_parts.append(part)
+        csizes.append(len(part))
+    comp = b"".join(comp_parts)
+    block_comp_start = np.zeros(len(csizes) + 1, dtype=np.int64)
+    np.cumsum(csizes, out=block_comp_start[1:])
+    offs = record_offsets.astype(np.int64)
+    block_idx = offs // BGZF_MAX_PAYLOAD
+    within = offs % BGZF_MAX_PAYLOAD
+    voffs = (block_comp_start[block_idx].astype(np.uint64) << np.uint64(16)) | within.astype(np.uint64)
+    return comp, voffs[:-1], voffs[1:]
+
+
+class BamSink:
+    """Single-file BAM write (``FileCardinalityWriteOption.SINGLE``)."""
+
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def _num_shards(self) -> int:
+        n = getattr(self._storage, "_num_shards", None)
+        if n:
+            return n
+        try:
+            import jax
+
+            return len(jax.devices())
+        except Exception:
+            return 1
+
+    def save(
+        self, dataset, path: str, options: Sequence[WriteOption] = ()
+    ) -> None:
+        fs, path = resolve_path(path)
+        header: SamHeader = dataset.header
+        batch: ReadBatch = dataset.reads
+        write_bai = _opt_enabled(options, BaiWriteOption, False)
+        write_sbi = _opt_enabled(options, SbiWriteOption, False)
+        temp_dir = next(
+            (o.path for o in options if isinstance(o, TempPartsDirectoryWriteOption)),
+            path + ".parts",
+        )
+        if write_bai and header.sort_order != "coordinate":
+            raise ValueError(
+                "BAI requires a coordinate-sorted header; "
+                "sort first (ReadsStorage.write(..., sort=True))"
+            )
+
+        n_shards = min(self._num_shards(), max(1, batch.count))
+        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        fs.mkdirs(temp_dir)
+
+        part_paths: List[str] = []
+        part_lens: List[int] = []
+        sbi_frags: List[SbiIndex] = []
+        bai_frags: List[BaiIndex] = []
+        for k in range(n_shards):
+            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+            blob, rec_offs = encode_records_with_offsets(part)
+            comp, voffs, end_voffs = bgzf_compress_with_voffsets(blob, rec_offs)
+            part_path = os.path.join(temp_dir, f"part-{k:05d}")
+            fs.write_all(part_path, comp)
+            part_paths.append(part_path)
+            part_lens.append(len(comp))
+            if write_sbi:
+                sbi_frags.append(
+                    SbiIndex.build(
+                        voffs, int(end_voffs[-1]) if part.count else 0,
+                        0, granularity=SBI_GRANULARITY,
+                    )
+                )
+            if write_bai:
+                bai_frags.append(
+                    build_bai(
+                        part.refid, part.pos, part.alignment_ends(),
+                        part.flag, voffs, end_voffs, header.n_ref,
+                    )
+                )
+
+        # Driver side: header-only BGZF prefix, concat, terminator.
+        header_comp = compress_to_bgzf(header.to_bam_bytes(), with_terminator=False)
+        header_path = os.path.join(temp_dir, "_header")
+        fs.write_all(header_path, header_comp)
+        term_path = os.path.join(temp_dir, "_terminator")
+        fs.write_all(term_path, BGZF_EOF_MARKER)
+        fs.concat([header_path] + part_paths + [term_path], path)
+
+        part_starts = np.zeros(len(part_lens) + 1, dtype=np.int64)
+        np.cumsum(part_lens, out=part_starts[1:])
+        part_starts = part_starts[:-1] + len(header_comp)
+        file_length = fs.get_file_length(path)
+        if write_sbi:
+            merged = SbiIndex.merge(sbi_frags, list(part_starts), file_length)
+            fs.write_all(path + ".sbi", merged.to_bytes())
+        if write_bai:
+            merged_bai = merge_bai_fragments(bai_frags, list(part_starts))
+            fs.write_all(path + ".bai", merged_bai.to_bytes())
+        fs.delete(temp_dir, recursive=True)
+
+
+class BamSinkMultiple:
+    """Directory-of-complete-BAMs write (``MULTIPLE`` cardinality;
+    ref: ``AnySamSinkMultiple.java``)."""
+
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        fs, path = resolve_path(path)
+        header: SamHeader = dataset.header
+        batch: ReadBatch = dataset.reads
+        sink = BamSink(self._storage)
+        n_shards = min(sink._num_shards(), max(1, batch.count))
+        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        fs.mkdirs(path)
+        header_bytes = header.to_bam_bytes()
+        for k in range(n_shards):
+            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+            data = compress_to_bgzf(header_bytes + encode_records(part))
+            fs.write_all(os.path.join(path, f"part-r-{k:05d}.bam"), data)

@@ -1,0 +1,206 @@
+"""BamSource — the parallel BAM read path.
+
+Reference parity: ``impl/formats/bam/BamSource.java`` (SURVEY.md §2.4,
+call stack §3.1): header read on the host ("driver"); the file is cut
+into byte-range splits; each split resolves its first whole-record
+boundary — via the ``.sbi`` splitting index when present, else the
+``BgzfBlockGuesser`` + ``BamRecordGuesser`` chain — and decodes records
+from its own boundary up to the *next* split's boundary, reading past its
+byte-range end to finish the straddling record ("first owner" rule).
+
+TPU-first shape: each split yields a columnar ``ReadBatch`` (not record
+objects); split workers are host-side and feed device shards. Interval
+traversal (``.bai``) lives in ``disq_tpu.traversal``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from disq_tpu.bam.codec import decode_records, scan_record_offsets
+from disq_tpu.bam.columnar import ReadBatch
+from disq_tpu.bam.guesser import BamRecordGuesser
+from disq_tpu.bam.header import SamHeader
+from disq_tpu.bgzf.block import BGZF_EOF_MARKER, make_virtual_offset
+from disq_tpu.bgzf.codec import BgzfReader, inflate_blocks
+from disq_tpu.bgzf.guesser import BgzfBlockGuesser, _walk_blocks_collect
+from disq_tpu.fsw.filesystem import (
+    FileSystemWrapper,
+    PathSplit,
+    compute_path_splits,
+    resolve_path,
+)
+from disq_tpu.index.sbi import SbiIndex
+
+
+def read_header(fs: FileSystemWrapper, path: str) -> Tuple[SamHeader, int]:
+    """Host-side header read; returns (header, virtual offset of the first
+    record) — the analogue of ``AbstractSamSource#getFileHeader``."""
+    with fs.open(path) as raw:
+        r = BgzfReader(raw)
+        header = SamHeader.from_bam_stream(r)
+        return header, r.tell_virtual()
+
+
+class BamSource:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    @property
+    def split_size(self) -> int:
+        return getattr(self._storage, "_split_size", 128 * 1024 * 1024)
+
+    # -- public -------------------------------------------------------------
+
+    def get_reads(self, path: str, traversal=None):
+        from disq_tpu.api import ReadsDataset
+
+        fs, path = resolve_path(path)
+        header, first_voffset = read_header(fs, path)
+        if traversal is not None:
+            from disq_tpu.traversal.bai_query import read_with_traversal
+
+            batch = read_with_traversal(fs, path, header, traversal, self)
+            return ReadsDataset(header=header, reads=batch)
+        batches = self.read_split_batches(fs, path, header, first_voffset)
+        return ReadsDataset(header=header, reads=ReadBatch.concat(batches))
+
+    # -- split machinery ----------------------------------------------------
+
+    def read_split_batches(
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        header: SamHeader,
+        first_voffset: int,
+        split_size: Optional[int] = None,
+    ) -> List[ReadBatch]:
+        """One columnar batch per split — the unit that maps 1:1 onto
+        device shards in the distributed pipeline."""
+        splits = compute_path_splits(fs, path, split_size or self.split_size)
+        sbi = self._try_load_sbi(fs, path)
+        boundaries = self._split_boundaries(fs, path, header, first_voffset, splits, sbi)
+        out = []
+        for i in range(len(splits)):
+            lo, hi = boundaries[i], boundaries[i + 1]
+            out.append(self._decode_range(fs, path, header, lo, hi))
+        return out
+
+    def _try_load_sbi(self, fs: FileSystemWrapper, path: str) -> Optional[SbiIndex]:
+        sbi_path = path + ".sbi"
+        if fs.exists(sbi_path):
+            return SbiIndex.from_bytes(fs.read_all(sbi_path))
+        return None
+
+    def _data_end_voffset(self, fs: FileSystemWrapper, path: str) -> int:
+        """Virtual offset one past the last record: EOF minus terminator."""
+        length = fs.get_file_length(path)
+        tail = fs.read_range(path, max(0, length - len(BGZF_EOF_MARKER)), len(BGZF_EOF_MARKER))
+        end = length - len(BGZF_EOF_MARKER) if tail == BGZF_EOF_MARKER else length
+        return make_virtual_offset(end, 0)
+
+    def _split_boundaries(
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        header: SamHeader,
+        first_voffset: int,
+        splits: List[PathSplit],
+        sbi: Optional[SbiIndex],
+    ) -> List[int]:
+        """Virtual offsets b[0..n]: split i decodes records in
+        [b[i], b[i+1]). b[0] = first record (from the header read);
+        b[n] = end of data."""
+        end_vo = self._data_end_voffset(fs, path)
+        bounds = [first_voffset]
+        for s in splits[1:]:
+            if sbi is not None:
+                vo = sbi.first_offset_at_or_after(s.start)
+            else:
+                vo = self._guess_record_voffset(fs, path, header, s.start)
+                if vo is None:
+                    vo = end_vo
+            bounds.append(max(min(vo, end_vo), bounds[-1]))
+        bounds.append(end_vo)
+        return bounds
+
+    def _guess_record_voffset(
+        self, fs: FileSystemWrapper, path: str, header: SamHeader, file_offset: int
+    ) -> Optional[int]:
+        """First record boundary at-or-after ``file_offset`` (SURVEY §3.1:
+        BgzfBlockGuesser → BamRecordGuesser over a decompressed window)."""
+        if file_offset == 0:
+            raise ValueError("offset 0 is resolved by the header read")
+        bg = BgzfBlockGuesser(fs, path)
+        block_start = bg.guess_block_start(file_offset)
+        if block_start is None:
+            return None
+        g = BamRecordGuesser(header.n_ref, [s.length for s in header.sequences])
+        file_length = fs.get_file_length(path)
+        # Decompress a window and search; a single huge record (long-read
+        # BAMs) can exceed any fixed window, so grow geometrically until a
+        # boundary is found or the window reaches EOF.
+        window_csize = 4 * 0x10000
+        while True:
+            window_blocks, data = _walk_blocks_collect(
+                fs, path, block_start, block_start + window_csize, file_length
+            )
+            if not window_blocks:
+                return None
+            window = np.frombuffer(
+                inflate_blocks(data, window_blocks, base=block_start),
+                dtype=np.uint8,
+            )
+            u = g.find_first_record(window)
+            at_eof = window_blocks[-1].end >= file_length
+            if u is not None:
+                # Map window offset u back to a (block, within) voffset
+                # using the block usize table (ISIZE is verified on
+                # inflate, so cumulative usize == window offsets).
+                acc = 0
+                for b in window_blocks:
+                    if u < acc + b.usize:
+                        return make_virtual_offset(b.pos, u - acc)
+                    acc += b.usize
+                return None
+            if at_eof:
+                return None
+            window_csize *= 4
+
+    def _decode_range(
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        header: SamHeader,
+        lo_voffset: int,
+        hi_voffset: int,
+    ) -> ReadBatch:
+        """Decode all records whose start lies in [lo, hi) virtual space.
+
+        Reads compressed blocks from lo's block through hi's block — i.e.
+        past the split's byte-range end when a record straddles it.
+        """
+        if hi_voffset <= lo_voffset:
+            return ReadBatch.empty()
+        lo_block, lo_u = lo_voffset >> 16, lo_voffset & 0xFFFF
+        hi_block, hi_u = hi_voffset >> 16, hi_voffset & 0xFFFF
+        length = fs.get_file_length(path)
+        # Walk blocks from lo_block through hi_block (inclusive iff hi_u>0);
+        # the walk stages the compressed bytes so inflation re-uses them.
+        want_end = hi_block + (1 if hi_u > 0 else 0)
+        blocks, data = _walk_blocks_collect(
+            fs, path, lo_block, max(want_end, lo_block + 1), length
+        )
+        if not blocks:
+            return ReadBatch.empty()
+        blob = inflate_blocks(data, blocks, base=lo_block)
+        if hi_u > 0:
+            acc_before_hi = sum(b.usize for b in blocks if b.pos < hi_block)
+            end_u = acc_before_hi + hi_u
+        else:
+            end_u = len(blob)
+        record_bytes = np.frombuffer(blob, dtype=np.uint8)[lo_u:end_u]
+        offsets = scan_record_offsets(record_bytes)
+        return decode_records(record_bytes, offsets, n_ref=header.n_ref)

@@ -1,0 +1,18 @@
+from disq_tpu.bgzf.block import (  # noqa: F401
+    BgzfBlock,
+    BGZF_EOF_MARKER,
+    BGZF_HEADER_SIZE,
+    BGZF_MAX_BLOCK_SIZE,
+    make_virtual_offset,
+    split_virtual_offset,
+)
+from disq_tpu.bgzf.guesser import BgzfBlockGuesser, find_block_table  # noqa: F401
+from disq_tpu.bgzf.codec import (  # noqa: F401
+    inflate_block,
+    inflate_blocks,
+    deflate_block,
+    compress_to_bgzf,
+    decompress_bgzf,
+    BgzfWriter,
+    BgzfReader,
+)

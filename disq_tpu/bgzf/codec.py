@@ -1,0 +1,250 @@
+"""BGZF inflate/deflate — host codec path.
+
+Replaces htsjdk's ``BlockCompressedInputStream`` / ``OutputStream``
+(SURVEY.md §2.8). The per-block codec here is host zlib; the native C++
+threaded codec (``disq_tpu.native``) plugs in behind the same functions
+when built, and a Pallas inflate kernel is the planned device path — all
+three share this module's block framing.
+
+**Canonical deflate pin** (the byte-identity contract from BASELINE.md):
+raw DEFLATE, zlib level 6, memLevel 8, default strategy. All BGZF output
+in this framework uses exactly these parameters, so repeated writes of the
+same records are byte-identical.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+from typing import BinaryIO, List, Optional, Sequence
+
+from disq_tpu.bgzf.block import (
+    BGZF_EOF_MARKER,
+    BGZF_FOOTER_SIZE,
+    BGZF_HEADER_SIZE,
+    BGZF_MAX_PAYLOAD,
+    BgzfBlock,
+    build_block_header,
+    make_virtual_offset,
+    parse_block_header,
+)
+
+CANONICAL_LEVEL = 6
+CANONICAL_MEMLEVEL = 8
+
+
+def inflate_block(data: bytes, offset: int = 0, verify_crc: bool = True) -> bytes:
+    """Inflate one BGZF block whose header begins at ``offset``."""
+    total = parse_block_header(data, offset)
+    # Compressed payload sits between the (variable-length) header and the
+    # 8-byte footer. Header length = 12 + XLEN.
+    xlen = struct.unpack_from("<H", data, offset + 10)[0]
+    hdr_len = 12 + xlen
+    payload = data[offset + hdr_len: offset + total - BGZF_FOOTER_SIZE]
+    crc, isize = struct.unpack_from("<II", data, offset + total - BGZF_FOOTER_SIZE)
+    out = zlib.decompress(payload, wbits=-15, bufsize=isize or 1)
+    if len(out) != isize:
+        raise ValueError(f"BGZF ISIZE mismatch: {len(out)} != {isize}")
+    if verify_crc and zlib.crc32(out) != crc:
+        raise ValueError("BGZF CRC mismatch")
+    return out
+
+
+def inflate_blocks(
+    data: bytes, blocks: Sequence[BgzfBlock], base: int = 0, verify_crc: bool = True
+) -> bytes:
+    """Inflate many blocks from a staged buffer. ``base`` is the file
+    offset at which ``data[0]`` sits, so ``BgzfBlock.pos`` (absolute)
+    indexes correctly into the buffer."""
+    parts = [
+        inflate_block(data, b.pos - base, verify_crc=verify_crc) for b in blocks
+    ]
+    return b"".join(parts)
+
+
+def deflate_block(payload: bytes) -> bytes:
+    """Payload (≤65280 bytes) → one complete canonical BGZF block."""
+    if len(payload) > BGZF_MAX_PAYLOAD:
+        raise ValueError(f"payload too large for one BGZF block: {len(payload)}")
+    c = zlib.compressobj(CANONICAL_LEVEL, zlib.DEFLATED, -15, CANONICAL_MEMLEVEL)
+    comp = c.compress(payload) + c.flush()
+    total = BGZF_HEADER_SIZE + len(comp) + BGZF_FOOTER_SIZE
+    if total > 0x10000:
+        # Incompressible worst case: store at level 0 (still DEFLATE framing).
+        c = zlib.compressobj(0, zlib.DEFLATED, -15, CANONICAL_MEMLEVEL)
+        comp = c.compress(payload) + c.flush()
+        total = BGZF_HEADER_SIZE + len(comp) + BGZF_FOOTER_SIZE
+    return (
+        build_block_header(total)
+        + comp
+        + struct.pack("<II", zlib.crc32(payload), len(payload))
+    )
+
+
+def compress_to_bgzf(data: bytes, with_terminator: bool = True) -> bytes:
+    """Whole buffer → BGZF bytes (blocks of ≤65280 payload)."""
+    out = bytearray()
+    for i in range(0, len(data), BGZF_MAX_PAYLOAD):
+        out += deflate_block(data[i: i + BGZF_MAX_PAYLOAD])
+    if with_terminator:
+        out += BGZF_EOF_MARKER
+    return bytes(out)
+
+
+def decompress_bgzf(data: bytes) -> bytes:
+    """Whole BGZF buffer → decompressed bytes (walks the BSIZE chain)."""
+    out = []
+    pos = 0
+    while pos < len(data):
+        total = parse_block_header(data, pos)
+        out.append(inflate_block(data, pos))
+        pos += total
+    return b"".join(out)
+
+
+class BgzfWriter:
+    """Streaming BGZF writer with virtual-offset tracking.
+
+    The write-side analogue of htsjdk ``BlockCompressedOutputStream``:
+    buffers payload to 65280 bytes, emits canonical blocks, and reports
+    ``tell_virtual()`` — the virtual offset the *next* written byte will
+    have — which is what index builders (BAI/SBI/TBI) record.
+
+    ``write_terminator=False`` produces a *headerless/terminatorless part*
+    for the single-file merge protocol (reference: ``BamSink`` writes
+    parts with no terminator; ``Merger`` appends one 28-byte terminator at
+    the end — SURVEY.md §3.3).
+    """
+
+    def __init__(self, stream: BinaryIO, write_terminator: bool = True):
+        self._stream = stream
+        self._buf = bytearray()
+        self._block_start = 0  # compressed bytes emitted so far
+        self._terminate = write_terminator
+        self._closed = False
+
+    def tell_virtual(self) -> int:
+        return make_virtual_offset(self._block_start, len(self._buf))
+
+    @property
+    def compressed_bytes_written(self) -> int:
+        return self._block_start
+
+    def write(self, data: bytes) -> int:
+        view = memoryview(data)
+        while view:
+            room = BGZF_MAX_PAYLOAD - len(self._buf)
+            take = min(room, len(view))
+            self._buf += view[:take]
+            view = view[take:]
+            if len(self._buf) == BGZF_MAX_PAYLOAD:
+                self._flush_block()
+        return len(data)
+
+    def _flush_block(self) -> None:
+        if not self._buf:
+            return
+        block = deflate_block(bytes(self._buf))
+        self._stream.write(block)
+        self._block_start += len(block)
+        self._buf.clear()
+
+    def flush(self) -> None:
+        """Flush buffered payload as a (possibly short) block."""
+        self._flush_block()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._flush_block()
+        if self._terminate:
+            self._stream.write(BGZF_EOF_MARKER)
+        self._stream.flush()
+        self._closed = True
+
+    def __enter__(self) -> "BgzfWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class BgzfReader(io.RawIOBase):
+    """Seekable decompressed view of a BGZF stream with virtual-offset
+    seek — the read-side analogue of htsjdk ``BlockCompressedInputStream``.
+
+    Used by header readers and the record guesser; bulk decode goes
+    through the batched ``inflate_blocks`` path instead.
+    """
+
+    def __init__(self, stream: BinaryIO):
+        self._stream = stream
+        self._block_start = 0      # file offset of current block
+        self._next_block = 0       # file offset of next block to read
+        self._ublock = b""         # decompressed current block
+        self._upos = 0             # position within _ublock
+        self._eof = False
+
+    def _load_block_at(self, file_offset: int) -> bool:
+        self._stream.seek(file_offset)
+        header = self._stream.read(BGZF_HEADER_SIZE)
+        if len(header) < BGZF_HEADER_SIZE:
+            self._eof = True
+            self._ublock = b""
+            self._upos = 0
+            # Position the virtual offset AT end-of-data, not at the stale
+            # previous block start.
+            self._block_start = file_offset
+            return False
+        total = parse_block_header(header)
+        rest = self._stream.read(total - BGZF_HEADER_SIZE)
+        if len(rest) < total - BGZF_HEADER_SIZE:
+            raise ValueError("truncated BGZF block")
+        self._ublock = inflate_block(header + rest)
+        self._upos = 0
+        self._block_start = file_offset
+        self._next_block = file_offset + total
+        self._eof = False
+        return True
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def tell_virtual(self) -> int:
+        if self._upos == len(self._ublock) and not self._eof:
+            # Positioned at the end of a block == start of the next.
+            return make_virtual_offset(self._next_block, 0)
+        return make_virtual_offset(self._block_start, self._upos)
+
+    def seek_virtual(self, voffset: int) -> None:
+        coffset, uoffset = voffset >> 16, voffset & 0xFFFF
+        if coffset != self._block_start or not self._ublock:
+            if not self._load_block_at(coffset) and uoffset != 0:
+                raise ValueError(f"virtual offset past EOF: {voffset:#x}")
+        if uoffset > len(self._ublock):
+            raise ValueError(f"uoffset beyond block: {voffset:#x}")
+        self._upos = uoffset
+
+    def read(self, n: int = -1) -> bytes:
+        out = bytearray()
+        while n != 0:
+            if self._upos >= len(self._ublock):
+                if self._eof or not self._load_block_at(self._next_block):
+                    break
+            avail = len(self._ublock) - self._upos
+            take = avail if n < 0 else min(n, avail)
+            out += self._ublock[self._upos: self._upos + take]
+            self._upos += take
+            if n > 0:
+                n -= take
+        return bytes(out)
+
+    def read_exact(self, n: int) -> bytes:
+        data = self.read(n)
+        if len(data) != n:
+            raise EOFError(f"wanted {n} bytes, got {len(data)}")
+        return data

@@ -1,0 +1,174 @@
+"""BGZF block-boundary guessing from an arbitrary byte offset.
+
+Reference parity: ``impl/formats/bgzf/BgzfBlockGuesser.java`` (itself a
+descendant of Hadoop-BAM's ``BGZFSplitGuesser``). Mechanism: scan forward
+from the split offset for bytes that look like a BGZF member header
+(gzip magic ``1f 8b``, CM=8, FLG.FEXTRA, an XLEN-bounded extra field whose
+``BC`` subfield yields BSIZE), then *confirm* by checking that BSIZE
+chains to further plausible block headers — false positives die
+geometrically with chain depth.
+
+TPU-first design note: rather than the reference's byte-at-a-time stream
+scan, candidate positions are found with a vectorized numpy compare over
+the staged split buffer (the same algorithm a Pallas scan kernel would
+run; host numpy is already memory-bound here), then only candidates pay
+the chain-validation cost.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+from disq_tpu.bgzf.block import (
+    BGZF_HEADER_SIZE,
+    BGZF_FOOTER_SIZE,
+    BGZF_MAX_BLOCK_SIZE,
+    BgzfBlock,
+    parse_block_header,
+)
+from disq_tpu.fsw.filesystem import FileSystemWrapper
+
+# How many successor headers must chain-validate before we accept a
+# candidate. The reference confirms by following BSIZE to the next block;
+# two extra links make the false-positive probability negligible
+# (each link requires 4 magic bytes + structural fields to match).
+CHAIN_DEPTH = 2
+
+# When guessing near a split boundary we must look at most one maximal
+# block past the boundary to find a block start.
+_OVERRUN = 2 * BGZF_MAX_BLOCK_SIZE
+
+
+def _candidate_positions(buf: np.ndarray) -> np.ndarray:
+    """Vectorized scan: positions where the 4 fixed header bytes match."""
+    if buf.size < BGZF_HEADER_SIZE:
+        return np.empty(0, dtype=np.int64)
+    m = (
+        (buf[:-3] == 0x1F)
+        & (buf[1:-2] == 0x8B)
+        & (buf[2:-1] == 0x08)
+        & (buf[3:] == 0x04)
+    )
+    return np.nonzero(m)[0].astype(np.int64)
+
+
+def _chain_validate(
+    data: bytes, pos: int, file_tail_known: bool, depth: int = CHAIN_DEPTH
+) -> bool:
+    """Follow BSIZE links from ``pos``; True iff ``depth`` links hold.
+
+    ``file_tail_known`` — ``data`` extends to EOF, so running out of bytes
+    mid-header is a *failure* unless we are exactly at EOF.
+    """
+    p = pos
+    for _ in range(depth + 1):
+        if p == len(data) and file_tail_known:
+            return True  # clean EOF — the chain ran off the end of the file
+        try:
+            total = parse_block_header(data, p)
+        except ValueError:
+            # Not enough bytes to judge: optimistic accept when the buffer
+            # simply ended (caller gave a bounded window, not the file).
+            if p + BGZF_HEADER_SIZE > len(data) and not file_tail_known:
+                return True
+            return False
+        p += total
+        if p > len(data) and not file_tail_known:
+            return True
+    return True
+
+
+class BgzfBlockGuesser:
+    """Find the first true BGZF block at-or-after an arbitrary offset."""
+
+    def __init__(self, fs: FileSystemWrapper, path: str):
+        self.fs = fs
+        self.path = path
+        self.length = fs.get_file_length(path)
+
+    def guess_block_start(self, offset: int) -> Optional[int]:
+        """Absolute file offset of the first block starting at ``>= offset``,
+        or None if none exists before EOF."""
+        if offset >= self.length:
+            return None
+        window_len = min(_OVERRUN + BGZF_HEADER_SIZE, self.length - offset)
+        data = self.fs.read_range(self.path, offset, window_len)
+        tail_known = offset + window_len >= self.length
+        arr = np.frombuffer(data, dtype=np.uint8)
+        for cand in _candidate_positions(arr):
+            if _chain_validate(data, int(cand), tail_known):
+                return offset + int(cand)
+        return None
+
+    def blocks_in_split(self, start: int, end: int) -> List[BgzfBlock]:
+        """All blocks whose *start* lies in ``[start, end)`` — the
+        "first owner" rule of ``BgzfBlockSource`` (a block straddling
+        ``end`` belongs to this split)."""
+        first = self.guess_block_start(start)
+        if first is None or first >= end:
+            return []
+        return _walk_blocks(self.fs, self.path, first, end, self.length)
+
+
+def _walk_blocks(
+    fs: FileSystemWrapper, path: str, first: int, end: int, file_length: int
+) -> List[BgzfBlock]:
+    """Walk the BSIZE chain from a known block start, collecting blocks
+    that start before ``end``. Buffered: reads ahead in large chunks so
+    walking is one range-read per ~8 MiB, not per block."""
+    return _walk_blocks_collect(fs, path, first, end, file_length)[0]
+
+
+def _walk_blocks_collect(
+    fs: FileSystemWrapper, path: str, first: int, end: int, file_length: int
+) -> tuple[List[BgzfBlock], bytes]:
+    """As ``_walk_blocks``, but also returns the staged compressed bytes
+    covering exactly ``[first, last_block.end)`` — so callers that go on
+    to inflate don't re-read the range from storage."""
+    blocks: List[BgzfBlock] = []
+    data = bytearray()  # contiguous coverage from `first`
+    pos = first
+    CHUNK = 8 * 1024 * 1024
+    buf = b""
+    buf_start = 0
+    while pos < end and pos < file_length:
+        if not (buf_start <= pos and pos + BGZF_MAX_BLOCK_SIZE <= buf_start + len(buf)):
+            want = min(CHUNK, file_length - pos)
+            buf = fs.read_range(path, pos, want)
+            buf_start = pos
+            # Extend contiguous coverage; successive reads start at the
+            # current block start, which lies within already-covered span.
+            covered_to = first + len(data)
+            if buf_start + len(buf) > covered_to:
+                data += buf[covered_to - buf_start:]
+        rel = pos - buf_start
+        total = parse_block_header(buf, rel)
+        if rel + total > len(buf):
+            raise ValueError(f"truncated BGZF block at {pos} in {path}")
+        isize = struct.unpack_from("<I", buf, rel + total - 4)[0]
+        blocks.append(BgzfBlock(pos=pos, csize=total, usize=isize))
+        pos += total
+    if not blocks:
+        return [], b""
+    return blocks, bytes(data[: blocks[-1].end - first])
+
+
+def find_block_table(
+    fs: FileSystemWrapper, path: str, start: int = 0, end: Optional[int] = None
+) -> List[BgzfBlock]:
+    """Full (or range-bounded) block table of a BGZF file.
+
+    From offset 0 no guessing is needed (a BGZF file begins with a block);
+    from a nonzero offset the guesser finds the first boundary.
+    """
+    length = fs.get_file_length(path)
+    if end is None:
+        end = length
+    if start == 0:
+        if length == 0:
+            return []
+        return _walk_blocks(fs, path, 0, end, length)
+    return BgzfBlockGuesser(fs, path).blocks_in_split(start, end)

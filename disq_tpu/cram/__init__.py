@@ -1,0 +1,5 @@
+"""CRAM 3.0 support (reference parity: ``impl/formats/cram/``).
+
+Container walk, codec kernels, and reference-based reconstruction land
+in a dedicated milestone; until then source/sink raise cleanly.
+"""

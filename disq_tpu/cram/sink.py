@@ -1,0 +1,9 @@
+class CramSink:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def save(self, dataset, path, options=()):
+        raise NotImplementedError(
+            "CRAM write support is not built yet in this milestone "
+            "(planned, SURVEY.md §2.5)"
+        )

@@ -1,0 +1,10 @@
+class CramSource:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def get_reads(self, path, traversal=None):
+        raise NotImplementedError(
+            "CRAM read support is not built yet in this milestone "
+            "(planned: container walk + rANS/gzip block codecs, "
+            "SURVEY.md §2.5)"
+        )

@@ -1,0 +1,65 @@
+"""Format dispatch — maps path extension / write option → source/sink.
+
+Reference parity: ``impl/formats/sam/SamFormat.java`` + the write-option
+resolution inside ``HtsjdkReadsRddStorage#write`` (SURVEY.md L5).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from disq_tpu.api import FileCardinalityWriteOption, ReadsFormatWriteOption
+
+
+class SamFormat(enum.Enum):
+    BAM = ("bam", ".bam")
+    CRAM = ("cram", ".cram")
+    SAM = ("sam", ".sam")
+
+    def __init__(self, key: str, extension: str):
+        self.key = key
+        self.extension = extension
+
+    def make_source(self, storage):
+        if self is SamFormat.BAM:
+            from disq_tpu.bam.source import BamSource
+
+            return BamSource(storage)
+        if self is SamFormat.CRAM:
+            from disq_tpu.cram.source import CramSource
+
+            return CramSource(storage)
+        from disq_tpu.sam.source import SamSource
+
+        return SamSource(storage)
+
+    def make_sink(self, storage, cardinality: FileCardinalityWriteOption):
+        single = cardinality is FileCardinalityWriteOption.SINGLE
+        if self is SamFormat.BAM:
+            from disq_tpu.bam.sink import BamSink, BamSinkMultiple
+
+            return BamSink(storage) if single else BamSinkMultiple(storage)
+        if self is SamFormat.CRAM:
+            from disq_tpu.cram.sink import CramSink
+
+            return CramSink(storage)
+        from disq_tpu.sam.sink import SamSink, SamSinkMultiple
+
+        return SamSink(storage) if single else SamSinkMultiple(storage)
+
+
+def sam_format_from_path(path: str) -> SamFormat:
+    lowered = path.lower()
+    for fmt in SamFormat:
+        if lowered.endswith(fmt.extension):
+            return fmt
+    raise ValueError(f"cannot infer reads format from path {path!r}")
+
+
+def sam_format_from_write_options(
+    path: str, fmt_opt: Optional[ReadsFormatWriteOption]
+) -> SamFormat:
+    if fmt_opt is not None:
+        return SamFormat[fmt_opt.name]
+    return sam_format_from_path(path)

@@ -1,0 +1,9 @@
+from disq_tpu.fsw.filesystem import (  # noqa: F401
+    FileSystemWrapper,
+    PosixFileSystemWrapper,
+    MemoryFileSystemWrapper,
+    get_filesystem,
+    resolve_path,
+    PathSplit,
+    compute_path_splits,
+)

@@ -1,0 +1,244 @@
+"""Host file layer — the analogue of disq's file abstraction.
+
+Reference parity (see SURVEY.md §2.2):
+- ``FileSystemWrapper``  ← ``impl/file/FileSystemWrapper.java`` (interface:
+  exists / getFileLength / open / create / listDirectory /
+  firstFileInDirectory / concat / delete)
+- ``PosixFileSystemWrapper`` ← ``impl/file/NioFileSystemWrapper.java``
+- ``MemoryFileSystemWrapper`` — test double (no reference counterpart)
+- ``PathSplit`` + ``compute_path_splits`` ← ``impl/file/PathSplitSource.java``
+  / ``PathSplit.java`` (file → byte-range splits of ``split_size``)
+
+A GCS wrapper is intentionally gated: this build has zero egress. The
+registry (`get_filesystem`) dispatches on URI scheme so a `gs://` wrapper
+can slot in without touching call sites.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+from dataclasses import dataclass
+from typing import BinaryIO, Dict, List, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class PathSplit:
+    """A byte-range split of a file (reference: ``impl/file/PathSplit.java``).
+
+    ``end`` is exclusive. Splits tile the file exactly: split i covers
+    ``[i*split_size, min((i+1)*split_size, length))``.
+    """
+
+    path: str
+    start: int
+    end: int
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start
+
+
+# Default split size mirrors the Hadoop block size disq inherits via
+# PathSplitSource (128 MiB).
+DEFAULT_SPLIT_SIZE = 128 * 1024 * 1024
+
+
+class FileSystemWrapper:
+    """Uniform file ops used by every layer above.
+
+    Mirrors ``impl/file/FileSystemWrapper.java``. All paths are plain
+    strings; scheme-less paths are posix.
+    """
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def get_file_length(self, path: str) -> int:
+        raise NotImplementedError
+
+    def open(self, path: str) -> BinaryIO:
+        """Open a seekable binary read stream."""
+        raise NotImplementedError
+
+    def create(self, path: str) -> BinaryIO:
+        """Open a binary write stream, creating parent dirs as needed."""
+        raise NotImplementedError
+
+    def read_range(self, path: str, start: int, length: int) -> bytes:
+        """Range read — the staging primitive for device shard buffers."""
+        with self.open(path) as f:
+            f.seek(start)
+            return f.read(length)
+
+    def read_all(self, path: str) -> bytes:
+        return self.read_range(path, 0, self.get_file_length(path))
+
+    def write_all(self, path: str, data: bytes) -> None:
+        with self.create(path) as f:
+            f.write(data)
+
+    def list_directory(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def first_file_in_directory(self, path: str, suffix: str = "") -> str:
+        for p in self.list_directory(path):
+            if p.endswith(suffix):
+                return p
+        raise FileNotFoundError(f"no file with suffix {suffix!r} in {path}")
+
+    def concat(self, parts: Sequence[str], target: str) -> None:
+        """Concatenate ``parts`` into ``target`` (stream copy).
+
+        Reference: ``impl/file/Merger.java`` uses ``FileSystem#concat``
+        when available, else a stream copy; posix has no O(1) concat, so
+        this is always a copy here.
+        """
+        with self.create(target) as out:
+            for part in parts:
+                with self.open(part) as f:
+                    shutil.copyfileobj(f, out, 8 * 1024 * 1024)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def is_directory(self, path: str) -> bool:
+        raise NotImplementedError
+
+
+class PosixFileSystemWrapper(FileSystemWrapper):
+    """Local-filesystem impl (reference: ``impl/file/NioFileSystemWrapper.java``)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def get_file_length(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def open(self, path: str) -> BinaryIO:
+        return open(path, "rb")
+
+    def create(self, path: str) -> BinaryIO:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        return open(path, "wb")
+
+    def list_directory(self, path: str) -> List[str]:
+        return sorted(
+            os.path.join(path, name)
+            for name in os.listdir(path)
+            if not name.startswith(".") and not name.startswith("_")
+        )
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if os.path.isdir(path):
+            if recursive:
+                shutil.rmtree(path)
+            else:
+                os.rmdir(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def is_directory(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+
+class MemoryFileSystemWrapper(FileSystemWrapper):
+    """In-memory FS for tests and for staging shard buffers host-side."""
+
+    def __init__(self) -> None:
+        self._files: Dict[str, bytes] = {}
+
+    def exists(self, path: str) -> bool:
+        return path in self._files or self.is_directory(path)
+
+    def get_file_length(self, path: str) -> int:
+        return len(self._files[path])
+
+    def open(self, path: str) -> BinaryIO:
+        return io.BytesIO(self._files[path])
+
+    def create(self, path: str) -> BinaryIO:
+        fs = self
+
+        class _Writer(io.BytesIO):
+            def close(self) -> None:
+                fs._files[path] = self.getvalue()
+                super().close()
+
+        return _Writer()
+
+    def list_directory(self, path: str) -> List[str]:
+        prefix = path.rstrip("/") + "/"
+        names = [
+            p
+            for p in self._files
+            if p.startswith(prefix) and "/" not in p[len(prefix):]
+        ]
+        base = [n for n in names if not os.path.basename(n).startswith((".", "_"))]
+        return sorted(base)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if path in self._files:
+            del self._files[path]
+        elif recursive:
+            prefix = path.rstrip("/") + "/"
+            for p in [p for p in self._files if p.startswith(prefix)]:
+                del self._files[p]
+
+    def mkdirs(self, path: str) -> None:
+        pass
+
+    def is_directory(self, path: str) -> bool:
+        prefix = path.rstrip("/") + "/"
+        return any(p.startswith(prefix) for p in self._files)
+
+
+_POSIX = PosixFileSystemWrapper()
+
+
+def resolve_path(path: str) -> Tuple[FileSystemWrapper, str]:
+    """Scheme dispatch: URI → (wrapper, normalized path).
+
+    ``gs://`` is recognised but gated (zero egress).
+    """
+    if path.startswith("gs://") or path.startswith("s3://"):
+        raise NotImplementedError(
+            f"remote filesystem for {path!r} is gated in this build "
+            "(no network egress); register a wrapper via scheme dispatch"
+        )
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    return _POSIX, path
+
+
+def get_filesystem(path: str) -> FileSystemWrapper:
+    return resolve_path(path)[0]
+
+
+def compute_path_splits(
+    fs: FileSystemWrapper, path: str, split_size: int = DEFAULT_SPLIT_SIZE
+) -> List[PathSplit]:
+    """File → byte-range splits (reference: ``PathSplitSource#getPathSplits``).
+
+    Splits tile [0, length) exactly; the *content* owned by a split is
+    refined by the format layer (e.g. the BGZF "first owner" rule:
+    a block whose start lies in [start, end) belongs to that split even if
+    its bytes run past ``end``).
+    """
+    if split_size <= 0:
+        raise ValueError(f"split_size must be positive, got {split_size}")
+    length = fs.get_file_length(path)
+    if length == 0:
+        return []
+    return [
+        PathSplit(path, start, min(start + split_size, length))
+        for start in range(0, length, split_size)
+    ]

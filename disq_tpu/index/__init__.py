@@ -1,0 +1,2 @@
+from disq_tpu.index.sbi import SbiIndex  # noqa: F401
+from disq_tpu.index.bai import BaiIndex, reg2bin, build_bai, merge_bai_fragments  # noqa: F401

@@ -1,0 +1,1 @@
+"""Plain-text SAM support (reference parity: ``impl/formats/sam/``)."""

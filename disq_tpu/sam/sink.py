@@ -1,0 +1,13 @@
+class SamSink:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def save(self, dataset, path, options=()):
+        raise NotImplementedError(
+            "text SAM write support lands in the next milestone "
+            "(SURVEY.md §2.6)"
+        )
+
+
+class SamSinkMultiple(SamSink):
+    pass

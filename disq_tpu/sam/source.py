@@ -1,0 +1,9 @@
+class SamSource:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def get_reads(self, path, traversal=None):
+        raise NotImplementedError(
+            "text SAM read support lands in the next milestone "
+            "(SURVEY.md §2.6)"
+        )

@@ -1,0 +1,4 @@
+from disq_tpu.sort.coordinate import (  # noqa: F401
+    coordinate_sort_batch,
+    coordinate_keys,
+)

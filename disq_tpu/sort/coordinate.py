@@ -1,0 +1,56 @@
+"""Coordinate sort — first-class in this framework.
+
+Upstream disq does NOT sort (SURVEY.md §2.1 note: ``write`` trusts
+``header.getSortOrder()``; GATK does a Spark ``sortBy`` shuffle before
+calling it). Here the sort is owned by the framework: the single-host
+path below sorts a columnar batch by the 64-bit coordinate key; the
+multi-chip path (``disq_tpu.sort.sharded``) buckets records across the
+device mesh with a psum histogram + all_to_all exchange over ICI and
+reuses the same key.
+
+SAM coordinate order: ascending refID (unmapped refID=-1 LAST), then
+ascending pos; ties keep input order (stable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from disq_tpu.bam.columnar import ReadBatch
+
+# Key layout: (refid+1) in the high 32 bits with unmapped (refid -1)
+# remapped ABOVE all real refs, pos+1 in the low 32. Monotone w.r.t.
+# coordinate order, so one u64 radix/merge sort suffices.
+
+
+def coordinate_keys(refid: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    rid = refid.astype(np.int64)
+    rid = np.where(rid < 0, np.int64(0x7FFFFFFF), rid)
+    return (rid.astype(np.uint64) << np.uint64(32)) | (
+        (pos.astype(np.int64) + 1).astype(np.uint64) & np.uint64(0xFFFFFFFF)
+    )
+
+
+def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
+    """Sort a batch into coordinate order.
+
+    The permutation comes from the device mesh when more than one device
+    is attached (psum/all_to_all exchange, ``disq_tpu.sort.sharded``);
+    ragged columns are reordered host-side by one vectorized segment
+    gather either way.
+    """
+    keys = coordinate_keys(batch.refid, batch.pos)
+    order = None
+    if use_mesh and batch.count > 0:
+        try:
+            import jax
+
+            if len(jax.devices()) > 1:
+                from disq_tpu.sort.sharded import sharded_coordinate_sort
+
+                _, order = sharded_coordinate_sort(keys)
+        except Exception:
+            order = None
+    if order is None:
+        order = np.argsort(keys, kind="stable")
+    return batch.take(order)

@@ -1,0 +1,217 @@
+"""Multi-chip coordinate sort over a `jax.sharding.Mesh`.
+
+This replaces the Spark ``sortBy`` shuffle that the reference relies on
+its caller to run (SURVEY.md §2.9, §3.3): the only all-to-all in disq's
+world. TPU-native design (BASELINE.json north star; scaling-book recipe:
+pick a mesh, annotate shardings, let XLA insert collectives):
+
+1. each shard holds ``per_shard`` coordinate keys + row ids. Keys are
+   **u32 pairs** (hi = remapped refID, lo = pos+1) rather than one u64 —
+   TPUs are 32-bit-native and this framework keeps x64 emulation off the
+   hot path by construction;
+2. splitters (device count − 1 quantiles, sampled on host) define the
+   target shard of every key — a *range* partition, so after the exchange
+   the shards concatenate into global order;
+3. ``shard_map`` stage: group local keys by destination (one stable local
+   lexsort), scatter into a fixed-capacity ``(n_shards, cap)`` send
+   buffer, ``lax.all_to_all`` over the mesh axis (rides ICI on real
+   hardware), then one local lexsort of the received buffer;
+4. sentinel padding (``0xFFFFFFFF`` pairs) sorts to the end and is
+   dropped by the validity count; a ``psum`` over per-destination counts
+   flags capacity overflow (``ok``) without host round-trips inside the
+   step.
+
+Everything is static-shape and jit-compatible: no data-dependent Python
+control flow (XLA traces once); capacity overflow is handled by re-running
+with a larger ``capacity_factor`` (a host-side decision) — the
+deterministic, restartable-phase-plan shape from SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SENT32 = jnp.uint32(0xFFFFFFFF)
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shards") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def split_u64_keys(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host u64 coordinate keys → (hi, lo) u32 pairs for the device sort."""
+    return (
+        (keys >> np.uint64(32)).astype(np.uint32),
+        (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+    )
+
+
+def sample_splitters(keys: np.ndarray, n_shards: int, oversample: int = 64) -> np.ndarray:
+    """Host-side quantile splitters ((n_shards-1,) u64) for the range
+    partition. Deterministic (seeded): part of the restartable phase plan."""
+    if n_shards <= 1 or len(keys) == 0:
+        return np.zeros(max(n_shards - 1, 0), dtype=np.uint64)
+    rng = np.random.default_rng(0)
+    m = min(len(keys), n_shards * oversample)
+    sample = np.sort(rng.choice(keys, size=m, replace=False))
+    qs = (np.arange(1, n_shards) * m) // n_shards
+    return sample[qs].astype(np.uint64)
+
+
+def _dest_shard(hi, lo, s_hi, s_lo):
+    """Range-partition destination: number of splitters strictly less-or-
+    equal (side='right' semantics) computed by broadcast compare —
+    O(S·m) u32 ops, MXU/VPU-friendly, no 64-bit arithmetic."""
+    le = (s_hi[:, None] < hi[None, :]) | (
+        (s_hi[:, None] == hi[None, :]) & (s_lo[:, None] <= lo[None, :])
+    )
+    return jnp.sum(le, axis=0, dtype=jnp.int32)
+
+
+def _sort_stage(hi, lo, rows, s_hi, s_lo, *, axis: str, n_shards: int, cap: int):
+    """Per-shard body under shard_map. hi/lo/rows: (1, per_shard) blocks
+    with sentinel padding; s_hi/s_lo: (n_shards-1,) replicated."""
+    hi, lo, rows = hi.reshape(-1), lo.reshape(-1), rows.reshape(-1)
+    valid = ~((hi == SENT32) & (lo == SENT32))
+    dest = _dest_shard(hi, lo, s_hi, s_lo)
+    # Invalid (padding) entries route to a phantom bucket n_shards so they
+    # group after every real bucket and never inflate a real rank.
+    dest = jnp.where(valid, dest, n_shards)
+    order = jnp.argsort(dest, stable=True)
+    dest_g = dest[order]
+    hi_g, lo_g, rows_g = hi[order], lo[order], rows[order]
+    valid_g = valid[order]
+    counts = jnp.bincount(
+        jnp.where(valid_g, dest_g, 0),
+        weights=valid_g.astype(jnp.int32),
+        length=n_shards,
+    ).astype(jnp.int32)
+    m = hi.shape[0]
+    group_start = jnp.searchsorted(dest_g, dest_g, side="left")
+    within = jnp.arange(m) - group_start
+    send_hi = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
+    send_lo = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
+    send_rows = jnp.zeros((n_shards, cap), dtype=rows.dtype)
+    # Phantom-bucket and over-capacity entries fall outside the buffer and
+    # are dropped by scatter mode="drop"; overflow is flagged below.
+    send_hi = send_hi.at[dest_g, within].set(hi_g, mode="drop")
+    send_lo = send_lo.at[dest_g, within].set(lo_g, mode="drop")
+    send_rows = send_rows.at[dest_g, within].set(rows_g, mode="drop")
+    ok = jnp.all(lax.psum((counts > cap).astype(jnp.int32), axis) == 0)
+    # The exchange — rides ICI on real hardware.
+    recv_hi = lax.all_to_all(send_hi, axis, split_axis=0, concat_axis=0)
+    recv_lo = lax.all_to_all(send_lo, axis, split_axis=0, concat_axis=0)
+    recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0)
+    fh, fl, fr = recv_hi.reshape(-1), recv_lo.reshape(-1), recv_rows.reshape(-1)
+    final = jnp.lexsort((fl, fh))
+    out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
+    n_valid = jnp.sum(~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)
+    return out_hi[None], out_lo[None], out_rows[None], n_valid[None], ok[None]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "capacity_factor"))
+def sharded_sort_step(
+    hi: jax.Array,
+    lo: jax.Array,
+    rows: jax.Array,
+    s_hi: jax.Array,
+    s_lo: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "shards",
+    capacity_factor: float = 2.0,
+):
+    """One full sort exchange over the mesh.
+
+    Inputs (n_shards, per_shard), sharded over ``axis`` on dim 0, sentinel-
+    padded. Returns (hi, lo, rows, valid_counts, ok): each output shard
+    holds its key range ascending with sentinel tail; concatenating shards
+    trimmed to their valid counts yields the global order.
+    """
+    n_shards = mesh.shape[axis]
+    per_shard = hi.shape[1]
+    cap = min(int(per_shard * capacity_factor / n_shards) + 1, per_shard)
+    body = functools.partial(_sort_stage, axis=axis, n_shards=n_shards, cap=cap)
+    try:
+        from jax import shard_map  # jax >= 0.6 location
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None), P(None), P(None)),
+        out_specs=(P(axis, None), P(axis, None), P(axis, None), P(axis), P(axis)),
+    )(hi, lo, rows, s_hi, s_lo)
+
+
+def sharded_coordinate_sort(
+    keys_np: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis: str = "shards",
+    capacity_factor: float = 2.0,
+    max_retries: int = 3,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host convenience wrapper: u64 keys → (sorted keys, permutation).
+
+    Pads to shard-uniform shape, runs the device exchange, retries with a
+    doubled capacity on the (rare, skew-driven) overflow signal, and falls
+    back to one host argsort only if skew defeats ``max_retries``
+    capacity doublings.
+    """
+    mesh = mesh or make_mesh()
+    n_shards = mesh.shape[axis]
+    n = len(keys_np)
+    if n == 0:
+        return keys_np.copy(), np.zeros(0, dtype=np.int64)
+    per_shard = -(-n // n_shards)
+    padded = per_shard * n_shards
+    keys_p = np.full(padded, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=np.uint64)
+    keys_p[:n] = keys_np
+    hi_p, lo_p = split_u64_keys(keys_p)
+    rows_p = np.zeros(padded, dtype=np.uint32)
+    rows_p[:n] = np.arange(n, dtype=np.uint32)
+    splitters = sample_splitters(keys_np, n_shards)
+    s_hi, s_lo = split_u64_keys(splitters)
+    shard2d = NamedSharding(mesh, P(axis, None))
+    repl = NamedSharding(mesh, P(None))
+    args = (
+        jax.device_put(hi_p.reshape(n_shards, per_shard), shard2d),
+        jax.device_put(lo_p.reshape(n_shards, per_shard), shard2d),
+        jax.device_put(rows_p.reshape(n_shards, per_shard), shard2d),
+        jax.device_put(s_hi, repl),
+        jax.device_put(s_lo, repl),
+    )
+    for _ in range(max_retries):
+        oh, ol, orows, counts, ok = sharded_sort_step(
+            *args, mesh=mesh, axis=axis, capacity_factor=capacity_factor
+        )
+        if bool(jnp.all(ok)):
+            oh_h = np.asarray(oh)
+            ol_h = np.asarray(ol)
+            or_h = np.asarray(orows)
+            cnt = np.asarray(counts)
+            out_keys = np.concatenate(
+                [
+                    (oh_h[i, : cnt[i]].astype(np.uint64) << np.uint64(32))
+                    | ol_h[i, : cnt[i]].astype(np.uint64)
+                    for i in range(n_shards)
+                ]
+            )
+            out_rows = np.concatenate(
+                [or_h[i, : cnt[i]] for i in range(n_shards)]
+            ).astype(np.int64)
+            return out_keys, out_rows
+        capacity_factor *= 2.0
+    order = np.argsort(keys_np, kind="stable")
+    return keys_np[order], order

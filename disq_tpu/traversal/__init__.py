@@ -1,0 +1,1 @@
+from disq_tpu.traversal.bai_query import read_with_traversal  # noqa: F401

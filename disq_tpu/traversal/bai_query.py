@@ -1,0 +1,100 @@
+"""Indexed (interval) traversal — the BAI query read path.
+
+Reference parity: the traversal branch of ``BamSource`` (SURVEY.md §3.2):
+resolve ``path + ".bai"``, map intervals → chunk lists of virtual-offset
+pairs (coalesced), decode only those chunks, then apply an exact
+per-record overlap filter; unplaced-unmapped records are read from a
+dedicated tail chunk after the last mapped chunk when
+``traverse_unplaced_unmapped`` is set.
+
+Key invariant kept from the reference: chunk bounds are *virtual
+offsets*, so decode never sees a partial record. The overlap filter here
+is vectorized over the columnar batch instead of per-record
+(htsjdk ``OverlapDetector``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from disq_tpu.bam.columnar import ReadBatch
+from disq_tpu.bam.header import SamHeader
+from disq_tpu.fsw.filesystem import FileSystemWrapper
+from disq_tpu.index.bai import BaiIndex
+
+
+def _resolve_bai(fs: FileSystemWrapper, path: str) -> BaiIndex:
+    for cand in (path + ".bai", path[:-4] + ".bai" if path.endswith(".bam") else None):
+        if cand and fs.exists(cand):
+            return BaiIndex.from_bytes(fs.read_all(cand))
+    raise FileNotFoundError(f"no .bai index found for {path}")
+
+
+def chunks_for_intervals(
+    header: SamHeader, bai: BaiIndex, intervals
+) -> List[Tuple[int, int]]:
+    """Intervals → coalesced (start, end) virtual-offset chunks."""
+    chunks: List[Tuple[int, int]] = []
+    for iv in intervals:
+        refid = header.ref_index(iv.contig)
+        # 1-based closed interval → 0-based half-open
+        chunks += bai.chunks_for_interval(refid, iv.start - 1, iv.end)
+    chunks.sort()
+    merged: List[Tuple[int, int]] = []
+    for cb, ce in chunks:
+        if merged and cb <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], ce))
+        else:
+            merged.append((cb, ce))
+    return merged
+
+
+def overlap_mask(
+    batch: ReadBatch, header: SamHeader, intervals
+) -> np.ndarray:
+    """Vectorized record-overlaps-any-interval mask (0-based half-open)."""
+    mask = np.zeros(batch.count, dtype=bool)
+    if batch.count == 0:
+        return mask
+    ends = batch.alignment_ends()
+    for iv in intervals:
+        refid = header.ref_index(iv.contig)
+        beg0, end0 = iv.start - 1, iv.end  # half-open
+        mask |= (batch.refid == refid) & (batch.pos < end0) & (ends > beg0)
+    return mask
+
+
+def read_with_traversal(
+    fs: FileSystemWrapper,
+    path: str,
+    header: SamHeader,
+    traversal,
+    source,
+) -> ReadBatch:
+    """The §3.2 call stack: BAI → chunks → bounded decode → exact filter."""
+    bai = _resolve_bai(fs, path)
+    batches: List[ReadBatch] = []
+    last_mapped_end = 0
+    if traversal.intervals is not None:
+        chunks = chunks_for_intervals(header, bai, traversal.intervals)
+        for cb, ce in chunks:
+            sub = source._decode_range(fs, path, header, cb, ce)
+            batches.append(sub.filter(overlap_mask(sub, header, traversal.intervals)))
+    if traversal.traverse_unplaced_unmapped:
+        # Tail chunk: from the end of the last mapped chunk (max ref_end
+        # over all refs; fall back to start of data) to end of data.
+        for r in bai.refs:
+            if r.ref_end:
+                last_mapped_end = max(last_mapped_end, r.ref_end)
+        if last_mapped_end == 0:
+            from disq_tpu.bam.source import read_header
+
+            _, last_mapped_end = read_header(fs, path)
+        end_vo = source._data_end_voffset(fs, path)
+        tail = source._decode_range(fs, path, header, last_mapped_end, end_vo)
+        batches.append(tail.filter(tail.refid == -1))
+    if not batches:
+        return ReadBatch.empty()
+    return ReadBatch.concat(batches)

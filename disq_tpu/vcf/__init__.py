@@ -1,0 +1,1 @@
+"""VCF support (reference parity: ``impl/formats/vcf/``)."""

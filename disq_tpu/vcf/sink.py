@@ -1,0 +1,12 @@
+class VcfSink:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def save(self, dataset, path, options=()):
+        raise NotImplementedError(
+            "VCF write support lands in the next milestone (SURVEY.md §2.7)"
+        )
+
+
+class VcfSinkMultiple(VcfSink):
+    pass

@@ -1,0 +1,8 @@
+class VcfSource:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def get_variants(self, path, intervals=None):
+        raise NotImplementedError(
+            "VCF read support lands in the next milestone (SURVEY.md §2.7)"
+        )

@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Mirrors the reference's local-mode-Spark-as-cluster trick (SURVEY.md §4.1):
+tests run on a *virtual 8-device CPU mesh* so sharded decode/sort/merge
+exercises real multi-device semantics with no TPU attached. Must run
+before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The host image may pre-register a TPU backend via sitecustomize (jax is
+# already imported by the time conftest runs), so env vars alone are not
+# enough — override the platform selection post-import. The CPU client is
+# created lazily, after the XLA_FLAGS above, so it sees 8 devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_fs():
+    from disq_tpu.fsw import PosixFileSystemWrapper
+
+    return PosixFileSystemWrapper()
+
+
+@pytest.fixture()
+def mem_fs():
+    from disq_tpu.fsw import MemoryFileSystemWrapper
+
+    return MemoryFileSystemWrapper()

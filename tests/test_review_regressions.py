@@ -1,0 +1,80 @@
+"""Regression tests for code-review findings (round 1 reviews)."""
+
+import numpy as np
+import pytest
+
+from disq_tpu import ReadsStorage
+from disq_tpu.bam import BamRecordGuesser, decode_records, encode_records
+from disq_tpu.fsw import resolve_path
+
+from tests.bam_oracle import DEFAULT_REFS, ORecord, encode_record, make_bam_bytes, synth_records
+
+
+class TestFileUriNormalization:
+    def test_file_scheme_read(self, tmp_path):
+        p = tmp_path / "u.bam"
+        p.write_bytes(make_bam_bytes(DEFAULT_REFS, synth_records(20, with_edge_cases=False)))
+        ds = ReadsStorage.make_default().read("file://" + str(p))
+        assert ds.count() == 20
+
+    def test_resolve_path_strips(self):
+        fs, norm = resolve_path("file:///tmp/x.bam")
+        assert norm == "/tmp/x.bam"
+
+
+class TestCigarOverflowGuard:
+    def test_many_cigar_ops_rejected(self):
+        rec = ORecord(name="r", refid=0, pos=1, cigar=[(1, "M")], seq="A", qual=b"\x10")
+        batch = decode_records(encode_record(rec))
+        batch.cigars = np.zeros(70_000, dtype=np.uint32) | (1 << 4)
+        batch.cigar_offsets = np.array([0, 70_000], dtype=np.int64)
+        with pytest.raises(ValueError, match="65535"):
+            encode_records(batch)
+
+
+class TestChainPartialValidation:
+    def test_invalid_visible_prefix_rejected(self):
+        """A window-tail 'record' whose visible fixed fields are invalid
+        must not be accepted just because block_size points past the end."""
+        g = BamRecordGuesser(2, [1000, 1000])
+        rec = ORecord(name="ok", refid=0, pos=5, cigar=[(4, "M")], seq="ACGT", qual=b"\x10" * 4)
+        good = encode_record(rec)
+        # Craft a tail: plausible block_size (100000, extends past window)
+        # but refid=999999 — visible and invalid.
+        import struct
+
+        tail = struct.pack("<ii", 100_000, 999_999) + b"\x00" * 20
+        buf = np.frombuffer(good + tail, dtype=np.uint8)
+        assert not g.check_chain(buf, len(good))
+        # Whole chain from 0 must also fail (its tail is the bad record)
+        assert not g.check_chain(buf, 0, depth=10)
+
+    def test_valid_straddling_record_accepted(self):
+        g = BamRecordGuesser(len(DEFAULT_REFS), [l for _, l in DEFAULT_REFS])
+        recs = synth_records(30, with_edge_cases=False)
+        blob = b"".join(encode_record(r) for r in recs)
+        # Truncate mid-record: chain from 0 must still accept
+        buf = np.frombuffer(blob[: len(blob) - 37], dtype=np.uint8)
+        assert g.check_chain(buf, 0, depth=100)
+
+
+class TestHugeRecordSplitBoundary:
+    def test_record_larger_than_guess_window(self, tmp_path):
+        """One record whose bytes exceed the initial 256 KiB guess window:
+        split boundaries must still land correctly (window growth)."""
+        big_len = 400_000  # ~600 KiB record bytes once qual+seq included
+        recs = [
+            ORecord(name="small0", refid=0, pos=10, cigar=[(50, "M")],
+                    seq="A" * 50, qual=b"\x10" * 50),
+            ORecord(name="huge", refid=0, pos=100, cigar=[(big_len, "M")],
+                    seq="G" * big_len, qual=b"\x11" * big_len),
+            ORecord(name="small1", refid=0, pos=200_000, cigar=[(50, "M")],
+                    seq="C" * 50, qual=b"\x12" * 50),
+        ]
+        p = str(tmp_path / "huge.bam")
+        with open(p, "wb") as f:
+            f.write(make_bam_bytes(DEFAULT_REFS, recs, blocksize=60_000))
+        # Hostile split size cuts inside the huge record repeatedly.
+        ds = ReadsStorage.make_default().split_size(50_000).read(p)
+        assert ds.count() == 3
+        assert [ds.reads.name(i) for i in range(3)] == ["small0", "huge", "small1"]

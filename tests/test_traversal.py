@@ -1,0 +1,137 @@
+"""BAI interval-traversal tests (baseline config 3 path, SURVEY.md §3.2)."""
+
+import numpy as np
+import pytest
+
+from disq_tpu import BaiWriteOption, ReadsStorage, SbiWriteOption, TraversalParameters
+from disq_tpu.api import Interval
+
+from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, parse_bam, ref_span, synth_records
+
+
+@pytest.fixture(scope="module")
+def indexed_bam(tmp_path_factory):
+    """A coordinate-sorted, BAI-indexed BAM written by the framework."""
+    records = synth_records(1500, seed=11, unmapped_tail=12)
+    raw = str(tmp_path_factory.mktemp("trav") / "raw.bam")
+    with open(raw, "wb") as f:
+        f.write(make_bam_bytes(DEFAULT_REFS, records, blocksize=700))
+    storage = ReadsStorage.make_default().num_shards(4)
+    ds = storage.read(raw)
+    out = str(tmp_path_factory.mktemp("trav") / "sorted.bam")
+    storage.write(ds, out, BaiWriteOption.ENABLE, SbiWriteOption.ENABLE, sort=True)
+    with open(out, "rb") as f:
+        _, _, sorted_recs = parse_bam(f.read())
+    return out, sorted_recs
+
+
+def _expect_overlapping(records, contig_id, beg0, end0):
+    out = []
+    for r in records:
+        if r.refid != contig_id:
+            continue
+        span = max(ref_span(r), 1)
+        if r.pos < end0 and r.pos + span > beg0:
+            out.append(r.name)
+    return out
+
+
+class TestTraversal:
+    @pytest.mark.parametrize(
+        "contig,start,end",
+        [("chr1", 1, 5000), ("chr1", 40_000, 60_000), ("chr2", 1, 50_000),
+         ("chrM", 1, 16_569)],
+    )
+    def test_interval_query_matches_brute_force(self, indexed_bam, contig, start, end):
+        path, sorted_recs = indexed_bam
+        contig_id = [n for n, _ in DEFAULT_REFS].index(contig)
+        ds = ReadsStorage.make_default().read(
+            path, TraversalParameters(intervals=[Interval(contig, start, end)])
+        )
+        expect = _expect_overlapping(sorted_recs, contig_id, start - 1, end)
+        got = [ds.reads.name(i) for i in range(ds.reads.count)]
+        assert sorted(got) == sorted(expect)
+
+    def test_empty_interval(self, indexed_bam):
+        path, _ = indexed_bam
+        ds = ReadsStorage.make_default().read(
+            path, TraversalParameters(intervals=[Interval("chr2", 49_990, 49_999)])
+        )
+        # May be empty or tiny; must not crash and must only contain chr2
+        assert np.all(ds.reads.refid == 1) or ds.reads.count == 0
+
+    def test_unplaced_unmapped_only(self, indexed_bam):
+        path, sorted_recs = indexed_bam
+        ds = ReadsStorage.make_default().read(
+            path, TraversalParameters(intervals=[], traverse_unplaced_unmapped=True)
+        )
+        expect = [r.name for r in sorted_recs if r.refid == -1]
+        got = [ds.reads.name(i) for i in range(ds.reads.count)]
+        assert sorted(got) == sorted(expect)
+        assert len(got) == 12
+
+    def test_intervals_plus_unmapped(self, indexed_bam):
+        path, sorted_recs = indexed_bam
+        ds = ReadsStorage.make_default().read(
+            path,
+            TraversalParameters(
+                intervals=[Interval("chr1", 1, 100_000)],
+                traverse_unplaced_unmapped=True,
+            ),
+        )
+        expect = [r.name for r in sorted_recs if r.refid == 0] + [
+            r.name for r in sorted_recs if r.refid == -1
+        ]
+        assert ds.reads.count == len(expect)
+
+    def test_missing_bai_raises(self, tmp_path):
+        records = synth_records(10, with_edge_cases=False)
+        p = str(tmp_path / "noidx.bam")
+        with open(p, "wb") as f:
+            f.write(make_bam_bytes(DEFAULT_REFS, records))
+        with pytest.raises(FileNotFoundError, match="bai"):
+            ReadsStorage.make_default().read(
+                p, TraversalParameters(intervals=[Interval("chr1", 1, 10)])
+            )
+
+
+class TestRegressionsFromReview:
+    def test_long_read_name_rejected(self):
+        from disq_tpu.bam.codec import encode_records
+        from disq_tpu.bam.columnar import ReadBatch
+        import numpy as np
+
+        from tests.bam_oracle import ORecord, encode_record
+        from disq_tpu.bam.codec import decode_records
+
+        rec = ORecord(name="x" * 100, refid=0, pos=1, seq="ACGT", qual=b"\x10" * 4)
+        batch = decode_records(encode_record(rec))
+        # Forge an oversized name by stretching offsets
+        batch.names = np.zeros(300, dtype=np.uint8) + ord("a")
+        batch.name_offsets = np.array([0, 300], dtype=np.int64)
+        with pytest.raises(ValueError, match="254"):
+            encode_records(batch)
+
+    def test_bgzf_reader_tell_at_eof(self):
+        import io
+
+        from disq_tpu.bgzf import BgzfReader, compress_to_bgzf
+
+        payload = b"z" * 100_000
+        comp = compress_to_bgzf(payload)
+        r = BgzfReader(io.BytesIO(comp))
+        assert r.read(-1) == payload
+        r.read(1)  # push into EOF state
+        # tell must point at end-of-data (the terminator block), not at
+        # the stale last data block start.
+        assert (r.tell_virtual() >> 16) >= len(comp) - 28
+
+    def test_unimplemented_formats_raise_cleanly(self, tmp_path):
+        from disq_tpu import VariantsStorage
+
+        with pytest.raises(NotImplementedError, match="VCF"):
+            VariantsStorage.make_default().read("x.vcf")
+        with pytest.raises(NotImplementedError, match="SAM|sam"):
+            ReadsStorage.make_default().read("x.sam")
+        with pytest.raises(NotImplementedError, match="CRAM"):
+            ReadsStorage.make_default().read("x.cram")
