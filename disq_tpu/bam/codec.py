@@ -42,7 +42,9 @@ def scan_record_offsets(blob: bytes | np.ndarray, base: int = 0) -> np.ndarray:
     try:
         from disq_tpu.native import scan_bam_offsets_native
 
-        return scan_bam_offsets_native(buf, base)
+        # Match the Python fallback's semantics exactly: scanning starts
+        # AT `base` (bytes before it are not part of the record chain).
+        return scan_bam_offsets_native(buf[base:] if base else buf, base)
     except ImportError:
         pass
     end = len(buf)
@@ -82,6 +84,15 @@ def decode_records(
     if n == 0:
         return ReadBatch.empty()
 
+    try:
+        from disq_tpu.native import decode_records_native
+
+        cols = decode_records_native(buf, offsets)
+        _check_refids(cols["refid"], cols["next_refid"], n_ref)
+        return ReadBatch(**cols)
+    except ImportError:
+        pass
+
     starts = offsets[:-1]
     # One strided gather pulls every record's 4+32-byte prefix as (N, 36).
     fixed = buf[starts[:, None] + np.arange(4 + _FIXED)]
@@ -99,11 +110,7 @@ def decode_records(
     next_pos = as_i32[:, 7].copy()
     tlen = as_i32[:, 8].copy()
 
-    if n_ref is not None:
-        bad = (refid >= n_ref) | (refid < -1) | (next_refid >= n_ref) | (next_refid < -1)
-        if bad.any():
-            i = int(np.nonzero(bad)[0][0])
-            raise ValueError(f"record {i}: refID out of range ({refid[i]})")
+    _check_refids(refid, next_refid, n_ref)
 
     # Section start offsets, derived arithmetically from the fixed columns.
     name_start = starts + 4 + _FIXED
@@ -155,6 +162,15 @@ def decode_records(
     )
 
 
+def _check_refids(refid, next_refid, n_ref) -> None:
+    if n_ref is None:
+        return
+    bad = (refid >= n_ref) | (refid < -1) | (next_refid >= n_ref) | (next_refid < -1)
+    if bad.any():
+        i = int(np.nonzero(bad)[0][0])
+        raise ValueError(f"record {i}: refID out of range ({refid[i]})")
+
+
 def _ragged_gather(
     buf: np.ndarray, starts: np.ndarray, lens: np.ndarray
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -186,6 +202,12 @@ def encode_records_with_offsets(batch: ReadBatch) -> tuple[bytes, np.ndarray]:
     n = batch.count
     if n == 0:
         return b"", np.zeros(1, dtype=np.int64)
+    try:
+        from disq_tpu.native import encode_records_native
+
+        return encode_records_native(batch)
+    except ImportError:
+        pass
     name_len = np.diff(batch.name_offsets)
     if (name_len > 254).any():
         i = int(np.nonzero(name_len > 254)[0][0])

@@ -34,7 +34,7 @@ from disq_tpu.bam.codec import encode_records, encode_records_with_offsets
 from disq_tpu.bam.columnar import ReadBatch
 from disq_tpu.bam.header import SamHeader
 from disq_tpu.bgzf.block import BGZF_EOF_MARKER, BGZF_MAX_PAYLOAD
-from disq_tpu.bgzf.codec import compress_to_bgzf, deflate_block
+from disq_tpu.bgzf.codec import compress_to_bgzf, deflate_blob
 from disq_tpu.fsw.filesystem import FileSystemWrapper, resolve_path
 from disq_tpu.index.bai import BaiIndex, build_bai, merge_bai_fragments
 from disq_tpu.index.sbi import SbiIndex
@@ -55,13 +55,7 @@ def bgzf_compress_with_voffsets(
     """Deflate ``blob`` into canonical BGZF (no terminator) and return
     (compressed bytes, start voffsets, end voffsets) for the records whose
     uncompressed offsets are ``record_offsets`` ((N+1,): starts + end)."""
-    comp_parts: List[bytes] = []
-    csizes = []
-    for i in range(0, len(blob), BGZF_MAX_PAYLOAD):
-        part = deflate_block(blob[i: i + BGZF_MAX_PAYLOAD])
-        comp_parts.append(part)
-        csizes.append(len(part))
-    comp = b"".join(comp_parts)
+    comp, csizes = deflate_blob(blob)
     block_comp_start = np.zeros(len(csizes) + 1, dtype=np.int64)
     np.cumsum(csizes, out=block_comp_start[1:])
     offs = record_offsets.astype(np.int64)
@@ -109,7 +103,21 @@ class BamSink:
         n_shards = min(self._num_shards(), max(1, batch.count))
         bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
         fs.mkdirs(temp_dir)
+        try:
+            self._write_parts_and_merge(
+                fs, header, batch, path, temp_dir, n_shards, bounds,
+                write_bai, write_sbi,
+            )
+        finally:
+            # Idempotent write protocol (SURVEY.md §5): the merge is the
+            # commit point; the staging dir never outlives save(), whether
+            # it succeeds or raises.
+            fs.delete(temp_dir, recursive=True)
 
+    def _write_parts_and_merge(
+        self, fs, header, batch, path, temp_dir, n_shards, bounds,
+        write_bai, write_sbi,
+    ) -> None:
         part_paths: List[str] = []
         part_lens: List[int] = []
         sbi_frags: List[SbiIndex] = []
@@ -155,7 +163,6 @@ class BamSink:
         if write_bai:
             merged_bai = merge_bai_fragments(bai_frags, list(part_starts))
             fs.write_all(path + ".bai", merged_bai.to_bytes())
-        fs.delete(temp_dir, recursive=True)
 
 
 class BamSinkMultiple:

@@ -1,0 +1,331 @@
+// disq_tpu native host runtime.
+//
+// The hot host-side loops behind the JAX/device pipeline:
+//   - BAM record-offset scan (the block_size chain walk — sequential by
+//     nature, so it belongs in C, not Python)
+//   - batched BGZF block inflate (one raw-DEFLATE stream per block,
+//     embarrassingly parallel across blocks -> thread pool)
+//   - batched canonical BGZF deflate for the write path (zlib level 6,
+//     memLevel 8 — must stay byte-identical to the Python codec's pin in
+//     disq_tpu/bgzf/codec.py)
+//
+// Replaces the role htsjdk's BlockCompressedInputStream/OutputStream +
+// BAMRecordCodec inner loops play for the reference (SURVEY.md §2.8).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 disq_host.cpp -o libdisq_host.so -lz -pthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+extern "C" {
+
+// Walk the BAM record chain: buf holds concatenated records; writes up to
+// max_out offsets (of each record start) into out_offsets and finally the
+// end offset. Returns the number of records, or -1-errpos on corruption.
+int64_t disq_scan_bam_offsets(const uint8_t* buf, int64_t len,
+                              int64_t* out_offsets, int64_t max_out) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  while (pos + 4 <= len) {
+    int32_t block_size;
+    std::memcpy(&block_size, buf + pos, 4);
+    int64_t nxt = pos + 4 + (int64_t)block_size;
+    if (block_size < 32 || nxt > len) return -1 - pos;
+    if (n >= max_out) return -1 - pos;
+    out_offsets[n++] = pos;
+    pos = nxt;
+  }
+  if (pos != len) return -1 - pos;
+  out_offsets[n] = len;  // caller allocates max_out+1
+  return n;
+}
+
+// Count records without storing offsets (for sizing).
+int64_t disq_count_bam_records(const uint8_t* buf, int64_t len) {
+  int64_t pos = 0, n = 0;
+  while (pos + 4 <= len) {
+    int32_t block_size;
+    std::memcpy(&block_size, buf + pos, 4);
+    int64_t nxt = pos + 4 + (int64_t)block_size;
+    if (block_size < 32 || nxt > len) return -1 - pos;
+    n++;
+    pos = nxt;
+  }
+  if (pos != len) return -1 - pos;
+  return n;
+}
+
+static int inflate_one(const uint8_t* src, uint32_t csize, uint8_t* dst,
+                       uint32_t usize) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -15) != Z_OK) return 1;
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = csize;
+  zs.next_out = dst;
+  zs.avail_out = usize;
+  int ret = inflate(&zs, Z_FINISH);
+  uint32_t got = usize - zs.avail_out;
+  inflateEnd(&zs);
+  if (ret != Z_STREAM_END || got != usize) return 2;
+  return 0;
+}
+
+// Batched BGZF inflate. data: staged compressed bytes; block_off[i] is the
+// offset of block i's *gzip header* within data; hdr_len[i] the header
+// length (12+XLEN); csize[i] the total block size; usize[i] the payload's
+// uncompressed size. Output written at out + out_off[i]. check_crc != 0
+// verifies each block's CRC32. Returns 0 or the 1-based index of the
+// first failing block (negated for CRC failures).
+int64_t disq_bgzf_inflate_many(const uint8_t* data, const int64_t* block_off,
+                               const int32_t* hdr_len, const int32_t* csize,
+                               const int32_t* usize, int64_t nblocks,
+                               uint8_t* out, const int64_t* out_off,
+                               int32_t check_crc, int32_t nthreads) {
+  std::atomic<int64_t> next(0);
+  std::atomic<int64_t> fail(0);
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= nblocks || fail.load() != 0) return;
+      const uint8_t* src = data + block_off[i] + hdr_len[i];
+      uint32_t comp_len = (uint32_t)csize[i] - (uint32_t)hdr_len[i] - 8;
+      uint8_t* dst = out + out_off[i];
+      if (inflate_one(src, comp_len, dst, (uint32_t)usize[i]) != 0) {
+        fail.store(i + 1);
+        return;
+      }
+      if (check_crc) {
+        uint32_t want;
+        std::memcpy(&want, data + block_off[i] + csize[i] - 8, 4);
+        uint32_t got = crc32(0L, dst, (uint32_t)usize[i]);
+        if (got != want) {
+          fail.store(-(i + 1));
+          return;
+        }
+      }
+    }
+  };
+  int nt = nthreads > 0 ? nthreads : 1;
+  if (nt == 1 || nblocks < 4) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < nt; t++) threads.emplace_back(worker);
+    for (auto& th : threads) th.join();
+  }
+  return fail.load();
+}
+
+// Batched canonical BGZF deflate. payload split into blocks by pay_off
+// (nblocks+1 entries); block i's complete BGZF bytes (18-byte header +
+// deflate stream + 8-byte footer) are written at out + i*out_stride, its
+// total size into out_sizes[i]. Uses zlib level `level`, memLevel 8 —
+// byte-identical to the Python pin. Falls back to stored (level 0) when
+// the compressed block would exceed 64 KiB. Returns 0 or 1-based failing
+// block index.
+int64_t disq_bgzf_deflate_many(const uint8_t* payload, const int64_t* pay_off,
+                               int64_t nblocks, uint8_t* out,
+                               int64_t out_stride, int32_t* out_sizes,
+                               int32_t level, int32_t nthreads) {
+  static const uint8_t HDR[16] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0,
+                                  0,    0xff, 0x06, 0x00, 0x42, 0x43, 0x02, 0x00};
+  std::atomic<int64_t> next(0);
+  std::atomic<int64_t> fail(0);
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= nblocks || fail.load() != 0) return;
+      const uint8_t* src = payload + pay_off[i];
+      uint32_t plen = (uint32_t)(pay_off[i + 1] - pay_off[i]);
+      uint8_t* blk = out + i * out_stride;
+      for (int attempt = 0; attempt < 2; attempt++) {
+        int lvl = attempt == 0 ? level : 0;
+        z_stream zs;
+        std::memset(&zs, 0, sizeof(zs));
+        if (deflateInit2(&zs, lvl, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) !=
+            Z_OK) {
+          fail.store(i + 1);
+          return;
+        }
+        zs.next_in = const_cast<uint8_t*>(src);
+        zs.avail_in = plen;
+        zs.next_out = blk + 18;
+        zs.avail_out = (uint32_t)(out_stride - 26);
+        int ret = deflate(&zs, Z_FINISH);
+        uint32_t clen = (uint32_t)(out_stride - 26 - zs.avail_out);
+        deflateEnd(&zs);
+        if (ret != Z_STREAM_END) {
+          if (attempt == 0) continue;  // retry stored
+          fail.store(i + 1);
+          return;
+        }
+        uint32_t total = 18 + clen + 8;
+        if (total > 0x10000) {
+          if (attempt == 0) continue;  // retry stored
+          fail.store(i + 1);
+          return;
+        }
+        std::memcpy(blk, HDR, 16);
+        uint16_t bsize = (uint16_t)(total - 1);
+        std::memcpy(blk + 16, &bsize, 2);
+        uint32_t crc = crc32(0L, src, plen);
+        std::memcpy(blk + 18 + clen, &crc, 4);
+        std::memcpy(blk + 18 + clen + 4, &plen, 4);
+        out_sizes[i] = (int32_t)total;
+        break;
+      }
+    }
+  };
+  int nt = nthreads > 0 ? nthreads : 1;
+  if (nt == 1 || nblocks < 4) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < nt; t++) threads.emplace_back(worker);
+    for (auto& th : threads) th.join();
+  }
+  return fail.load();
+}
+
+// -- columnar record codec ---------------------------------------------------
+// Pass 2 of the BAM decode (disq_tpu/bam/codec.py): one sequential,
+// cache-friendly pass over the record blob replacing numpy's per-column
+// index-array gathers. Layout per record after the 4-byte block_size:
+// refID i32 · pos i32 · l_read_name u8 · mapq u8 · bin u16 · n_cigar u16 ·
+// flag u16 · l_seq i32 · next_refID i32 · next_pos i32 · tlen i32 ·
+// name · cigar · packed seq · qual · tags.
+
+// Phase A: extract fixed columns + section lengths (for offset cumsums).
+int64_t disq_bam_fixed_columns(const uint8_t* buf, int64_t buf_len,
+                               const int64_t* offsets,
+                               int64_t n, int32_t* refid, int32_t* pos,
+                               uint8_t* mapq, uint16_t* bin, uint16_t* flag,
+                               int32_t* next_refid, int32_t* next_pos,
+                               int32_t* tlen, int64_t* name_len,
+                               int64_t* n_cigar, int64_t* l_seq,
+                               int64_t* tag_len) {
+  for (int64_t i = 0; i < n; i++) {
+    // Bounds before any read: caller-supplied offsets are untrusted.
+    if (offsets[i] < 0 || offsets[i + 1] < offsets[i] + 36 ||
+        offsets[i + 1] > buf_len)
+      return -1 - i;
+    const uint8_t* r = buf + offsets[i];
+    int32_t v32;
+    uint16_t v16;
+    std::memcpy(&v32, r + 4, 4); refid[i] = v32;
+    std::memcpy(&v32, r + 8, 4); pos[i] = v32;
+    uint8_t lrn = r[12];
+    mapq[i] = r[13];
+    std::memcpy(&v16, r + 14, 2); bin[i] = v16;
+    uint16_t nc;
+    std::memcpy(&nc, r + 16, 2);
+    std::memcpy(&v16, r + 18, 2); flag[i] = v16;
+    int32_t ls;
+    std::memcpy(&ls, r + 20, 4);
+    std::memcpy(&v32, r + 24, 4); next_refid[i] = v32;
+    std::memcpy(&v32, r + 28, 4); next_pos[i] = v32;
+    std::memcpy(&v32, r + 32, 4); tlen[i] = v32;
+    if (lrn < 1 || ls < 0) return -1 - i;
+    name_len[i] = lrn - 1;
+    n_cigar[i] = nc;
+    l_seq[i] = ls;
+    int64_t sections = 32 + lrn + 4LL * nc + (ls + 1) / 2 + ls;
+    int64_t rec_len = offsets[i + 1] - offsets[i] - 4;
+    if (sections > rec_len) return -1 - i;
+    tag_len[i] = rec_len - sections;
+  }
+  return 0;
+}
+
+// Phase B: fill ragged columns (seq unpacked to one nibble code per byte).
+int64_t disq_bam_fill_ragged(const uint8_t* buf, const int64_t* offsets,
+                             int64_t n, const int64_t* name_off,
+                             uint8_t* names, const int64_t* cigar_off,
+                             uint32_t* cigars, const int64_t* seq_off,
+                             uint8_t* seqs, uint8_t* quals,
+                             const int64_t* tag_off, uint8_t* tags) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* r = buf + offsets[i];
+    uint8_t lrn = r[12];
+    int64_t nc = cigar_off[i + 1] - cigar_off[i];
+    int64_t ls = seq_off[i + 1] - seq_off[i];
+    const uint8_t* p = r + 36;
+    std::memcpy(names + name_off[i], p, lrn - 1);
+    p += lrn;
+    std::memcpy(cigars + cigar_off[i], p, 4 * nc);
+    p += 4 * nc;
+    uint8_t* sq = seqs + seq_off[i];
+    for (int64_t k = 0; k + 1 < ls; k += 2) {
+      uint8_t b = p[k >> 1];
+      sq[k] = b >> 4;
+      sq[k + 1] = b & 0xF;
+    }
+    if (ls & 1) sq[ls - 1] = p[(ls - 1) >> 1] >> 4;
+    p += (ls + 1) / 2;
+    std::memcpy(quals + seq_off[i], p, ls);
+    p += ls;
+    std::memcpy(tags + tag_off[i], p, tag_off[i + 1] - tag_off[i]);
+  }
+  return 0;
+}
+
+// Encode: columns -> record bytes, one pass (inverse of the above).
+// rec_off[i] gives each record's output start (precomputed cumsum).
+int64_t disq_bam_encode(uint8_t* out, const int64_t* rec_off, int64_t n,
+                        const int32_t* refid, const int32_t* pos,
+                        const uint8_t* mapq, const uint16_t* bin,
+                        const uint16_t* flag, const int32_t* next_refid,
+                        const int32_t* next_pos, const int32_t* tlen,
+                        const int64_t* name_off, const uint8_t* names,
+                        const int64_t* cigar_off, const uint32_t* cigars,
+                        const int64_t* seq_off, const uint8_t* seqs,
+                        const uint8_t* quals, const int64_t* tag_off,
+                        const uint8_t* tags) {
+  for (int64_t i = 0; i < n; i++) {
+    uint8_t* r = out + rec_off[i];
+    int64_t nl = name_off[i + 1] - name_off[i];
+    int64_t nc = cigar_off[i + 1] - cigar_off[i];
+    int64_t ls = seq_off[i + 1] - seq_off[i];
+    int64_t tl = tag_off[i + 1] - tag_off[i];
+    if (nl > 254 || nc > 0xFFFF) return -1 - i;
+    int32_t block_size =
+        (int32_t)(32 + (nl + 1) + 4 * nc + (ls + 1) / 2 + ls + tl);
+    std::memcpy(r, &block_size, 4);
+    std::memcpy(r + 4, refid + i, 4);
+    std::memcpy(r + 8, pos + i, 4);
+    r[12] = (uint8_t)(nl + 1);
+    r[13] = mapq[i];
+    std::memcpy(r + 14, bin + i, 2);
+    uint16_t nc16 = (uint16_t)nc;
+    std::memcpy(r + 16, &nc16, 2);
+    std::memcpy(r + 18, flag + i, 2);
+    int32_t ls32 = (int32_t)ls;
+    std::memcpy(r + 20, &ls32, 4);
+    std::memcpy(r + 24, next_refid + i, 4);
+    std::memcpy(r + 28, next_pos + i, 4);
+    std::memcpy(r + 32, tlen + i, 4);
+    uint8_t* p = r + 36;
+    std::memcpy(p, names + name_off[i], nl);
+    p[nl] = 0;
+    p += nl + 1;
+    std::memcpy(p, cigars + cigar_off[i], 4 * nc);
+    p += 4 * nc;
+    const uint8_t* sq = seqs + seq_off[i];
+    for (int64_t k = 0; k + 1 < ls; k += 2)
+      p[k >> 1] = (uint8_t)((sq[k] << 4) | (sq[k + 1] & 0xF));
+    if (ls & 1) p[(ls - 1) >> 1] = (uint8_t)(sq[ls - 1] << 4);
+    p += (ls + 1) / 2;
+    std::memcpy(p, quals + seq_off[i], ls);
+    p += ls;
+    std::memcpy(p, tags + tag_off[i], tl);
+  }
+  return 0;
+}
+
+}  // extern "C"

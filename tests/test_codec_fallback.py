@@ -1,0 +1,63 @@
+"""The pure-Python codec paths must stay correct (and byte-identical to
+the native paths) when the C++ runtime is unavailable."""
+
+import sys
+
+import numpy as np
+import pytest
+
+from tests.bam_oracle import DEFAULT_REFS, encode_record, synth_records
+
+
+@pytest.fixture()
+def no_native(monkeypatch):
+    """Block the native import so every try/ImportError falls back."""
+    monkeypatch.setitem(sys.modules, "disq_tpu.native", None)
+
+
+class TestFallbackEquivalence:
+    def test_decode_encode_roundtrip(self, no_native):
+        from disq_tpu.bam.codec import decode_records, encode_records
+
+        blob = b"".join(encode_record(r) for r in synth_records(200, seed=4, unmapped_tail=3))
+        batch = decode_records(blob, n_ref=len(DEFAULT_REFS))
+        assert encode_records(batch) == blob
+
+    def test_matches_native_columns(self, monkeypatch):
+        pytest.importorskip("disq_tpu.native")  # else this compares Python to itself
+        from disq_tpu.bam.codec import decode_records
+
+        blob = b"".join(encode_record(r) for r in synth_records(150, seed=5))
+        native_batch = decode_records(blob)
+        monkeypatch.setitem(sys.modules, "disq_tpu.native", None)
+        py_batch = decode_records(blob)
+        for f in (
+            "refid", "pos", "mapq", "bin", "flag", "next_refid", "next_pos",
+            "tlen", "name_offsets", "names", "cigar_offsets", "cigars",
+            "seq_offsets", "seqs", "quals", "tag_offsets", "tags",
+        ):
+            np.testing.assert_array_equal(
+                getattr(native_batch, f), getattr(py_batch, f), err_msg=f
+            )
+
+    def test_bgzf_deflate_identical(self, monkeypatch):
+        pytest.importorskip("disq_tpu.native")
+        from disq_tpu.bgzf.codec import compress_to_bgzf
+
+        payload = b"the same bytes either way" * 9000
+        native_out = compress_to_bgzf(payload)
+        monkeypatch.setitem(sys.modules, "disq_tpu.native", None)
+        py_out = compress_to_bgzf(payload)
+        assert native_out == py_out
+
+    def test_end_to_end_read_without_native(self, no_native, tmp_path):
+        from tests.bam_oracle import make_bam_bytes
+
+        from disq_tpu import ReadsStorage
+
+        recs = synth_records(100, seed=6)
+        p = str(tmp_path / "f.bam")
+        with open(p, "wb") as f:
+            f.write(make_bam_bytes(DEFAULT_REFS, recs, blocksize=700))
+        ds = ReadsStorage.make_default().split_size(2000).read(p)
+        assert ds.count() == 100
