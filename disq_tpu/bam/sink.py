@@ -38,6 +38,7 @@ from disq_tpu.bgzf.codec import compress_to_bgzf, deflate_blob
 from disq_tpu.fsw.filesystem import FileSystemWrapper, resolve_path
 from disq_tpu.index.bai import BaiIndex, build_bai, merge_bai_fragments
 from disq_tpu.index.sbi import SbiIndex
+from disq_tpu.util import resolve_num_shards
 
 SBI_GRANULARITY = 4096  # htsjdk SBIIndexWriter default
 
@@ -72,15 +73,7 @@ class BamSink:
         self._storage = storage
 
     def _num_shards(self) -> int:
-        n = getattr(self._storage, "_num_shards", None)
-        if n:
-            return n
-        try:
-            import jax
-
-            return len(jax.devices())
-        except Exception:
-            return 1
+        return resolve_num_shards(self._storage)
 
     def save(
         self, dataset, path: str, options: Sequence[WriteOption] = ()

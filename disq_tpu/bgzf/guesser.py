@@ -123,7 +123,8 @@ def _walk_blocks(
 
 
 def _walk_blocks_collect(
-    fs: FileSystemWrapper, path: str, first: int, end: int, file_length: int
+    fs: FileSystemWrapper, path: str, first: int, end: int, file_length: int,
+    chunk: int = 8 * 1024 * 1024,
 ) -> tuple[List[BgzfBlock], bytes]:
     """As ``_walk_blocks``, but also returns the staged compressed bytes
     covering exactly ``[first, last_block.end)`` — so callers that go on
@@ -131,12 +132,11 @@ def _walk_blocks_collect(
     blocks: List[BgzfBlock] = []
     data = bytearray()  # contiguous coverage from `first`
     pos = first
-    CHUNK = 8 * 1024 * 1024
     buf = b""
     buf_start = 0
     while pos < end and pos < file_length:
         if not (buf_start <= pos and pos + BGZF_MAX_BLOCK_SIZE <= buf_start + len(buf)):
-            want = min(CHUNK, file_length - pos)
+            want = min(chunk, file_length - pos)
             buf = fs.read_range(path, pos, want)
             buf_start = pos
             # Extend contiguous coverage; successive reads start at the

@@ -1,13 +1,68 @@
+"""SamSink — text SAM write paths.
+
+Reference parity: ``impl/formats/sam/SamSink.java`` (single file: header
+part + per-shard text parts + driver concat) and ``AnySamSinkMultiple``
+(directory of complete per-shard SAM files), SURVEY.md §2.6.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+from disq_tpu.api import TempPartsDirectoryWriteOption, WriteOption
+from disq_tpu.fsw.filesystem import resolve_path
+from disq_tpu.sam.text import batch_to_sam_lines
+
+
+from disq_tpu.util import resolve_num_shards as _num_shards
+
+
 class SamSink:
     def __init__(self, storage=None):
         self._storage = storage
 
-    def save(self, dataset, path, options=()):
-        raise NotImplementedError(
-            "text SAM write support lands in the next milestone "
-            "(SURVEY.md §2.6)"
+    def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        fs, path = resolve_path(path)
+        temp_dir = next(
+            (o.path for o in options if isinstance(o, TempPartsDirectoryWriteOption)),
+            path + ".parts",
         )
+        batch = dataset.reads
+        n_shards = min(_num_shards(self._storage), max(1, batch.count))
+        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        fs.mkdirs(temp_dir)
+        try:
+            header_path = os.path.join(temp_dir, "_header")
+            fs.write_all(header_path, dataset.header.text.encode())
+            part_paths: List[str] = []
+            for k in range(n_shards):
+                part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+                lines = batch_to_sam_lines(part, dataset.header)
+                body = "".join(ln + "\n" for ln in lines).encode()
+                p = os.path.join(temp_dir, f"part-{k:05d}")
+                fs.write_all(p, body)
+                part_paths.append(p)
+            fs.concat([header_path] + part_paths, path)
+        finally:
+            fs.delete(temp_dir, recursive=True)
 
 
-class SamSinkMultiple(SamSink):
-    pass
+class SamSinkMultiple:
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        fs, path = resolve_path(path)
+        batch = dataset.reads
+        n_shards = min(_num_shards(self._storage), max(1, batch.count))
+        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        fs.mkdirs(path)
+        header_text = dataset.header.text
+        for k in range(n_shards):
+            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+            lines = batch_to_sam_lines(part, dataset.header)
+            data = header_text.encode() + "".join(ln + "\n" for ln in lines).encode()
+            fs.write_all(os.path.join(path, f"part-r-{k:05d}.sam"), data)

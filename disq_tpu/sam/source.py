@@ -1,9 +1,72 @@
+"""SamSource — plain-text SAM read path.
+
+Reference parity: ``impl/formats/sam/SamSource.java`` (SURVEY.md §2.6):
+Hadoop text line splits; ``@`` header lines skipped in-task; lines parsed
+with the SAM line parser. The header is read host-side ("driver") from
+the file head.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from disq_tpu.bam.columnar import ReadBatch
+from disq_tpu.bam.header import SamHeader
+from disq_tpu.fsw.filesystem import FileSystemWrapper, compute_path_splits, resolve_path
+from disq_tpu.fsw.textsplit import lines_for_split
+from disq_tpu.sam.text import sam_lines_to_batch
+
+
+def read_sam_header(fs: FileSystemWrapper, path: str) -> SamHeader:
+    """Read the leading ``@`` lines (header) from a SAM file."""
+    text_lines: List[str] = []
+    pos = 0
+    length = fs.get_file_length(path)
+    CHUNK = 1 << 20
+    pending = b""
+    done = False
+    while pos < length and not done:
+        data = pending + fs.read_range(path, pos, min(CHUNK, length - pos))
+        pos += len(data) - len(pending)
+        lines = data.split(b"\n")
+        pending = lines.pop()
+        for ln in lines:
+            if ln.startswith(b"@"):
+                text_lines.append(ln.decode())
+            else:
+                done = True
+                break
+        if not done and pending and not pending.startswith(b"@") and pos >= length:
+            break
+    if not done and pending.startswith(b"@"):
+        # Final header line in a file without a trailing newline.
+        text_lines.append(pending.decode())
+    return SamHeader.from_text("\n".join(text_lines) + ("\n" if text_lines else ""))
+
+
 class SamSource:
     def __init__(self, storage=None):
         self._storage = storage
 
-    def get_reads(self, path, traversal=None):
-        raise NotImplementedError(
-            "text SAM read support lands in the next milestone "
-            "(SURVEY.md §2.6)"
-        )
+    @property
+    def split_size(self) -> int:
+        return getattr(self._storage, "_split_size", 128 * 1024 * 1024)
+
+    def get_reads(self, path: str, traversal=None):
+        from disq_tpu.api import ReadsDataset
+
+        if traversal is not None:
+            raise ValueError(
+                "interval traversal requires an indexed format (BAM/CRAM); "
+                "plain SAM has no index (reference behavior)"
+            )
+        fs, path = resolve_path(path)
+        header = read_sam_header(fs, path)
+        batches = []
+        for s in compute_path_splits(fs, path, self.split_size):
+            lines = [
+                ln.decode() for ln in lines_for_split(fs, path, s.start, s.end)
+                if ln and not ln.startswith(b"@")
+            ]
+            batches.append(sam_lines_to_batch(lines, header))
+        return ReadsDataset(header=header, reads=ReadBatch.concat(batches))

@@ -1,0 +1,18 @@
+"""Small shared helpers."""
+
+from __future__ import annotations
+
+
+def resolve_num_shards(storage) -> int:
+    """Shard count for write paths: the storage's ``num_shards`` override,
+    else the attached device count, else 1. Single source of truth for
+    every sink (BAM/SAM/VCF/CRAM)."""
+    n = getattr(storage, "_num_shards", None)
+    if n:
+        return n
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:
+        return 1

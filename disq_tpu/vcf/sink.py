@@ -1,12 +1,201 @@
+"""VcfSink — VCF write paths.
+
+Reference parity: ``impl/formats/vcf/VcfSink.java`` + ``VcfSinkMultiple``
+(SURVEY.md §2.7): single-file write stages per-shard serialized
+(optionally compressed) parts, the driver writes the header prefix,
+concatenates, appends the BGZF terminator when block-compressed, and
+merges per-part ``.tbi`` fragments when tabix indexing is enabled.
+
+Compression selection mirrors ``VariantsFormatWriteOption``: VCF (plain),
+VCF_GZ (whole-file gzip, not splittable), VCF_BGZ (BGZF blocks —
+splittable, indexable).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from disq_tpu.api import (
+    TabixIndexWriteOption,
+    TempPartsDirectoryWriteOption,
+    VariantsFormatWriteOption,
+    WriteOption,
+)
+from disq_tpu.bgzf.block import BGZF_EOF_MARKER, BGZF_MAX_PAYLOAD
+from disq_tpu.bgzf.codec import deflate_blob
+from disq_tpu.fsw.filesystem import resolve_path
+from disq_tpu.index.tbi import TbiIndex, build_tbi, merge_tbi_fragments
+from disq_tpu.vcf.columnar import VariantBatch
+
+
+def _format_for(path: str, options: Sequence[WriteOption]) -> VariantsFormatWriteOption:
+    for o in options:
+        if isinstance(o, VariantsFormatWriteOption):
+            return o
+    lowered = path.lower()
+    if lowered.endswith(".vcf.bgz") or lowered.endswith(".bgz"):
+        return VariantsFormatWriteOption.VCF_BGZ
+    if lowered.endswith(".gz"):
+        return VariantsFormatWriteOption.VCF_GZ
+    return VariantsFormatWriteOption.VCF
+
+
+from disq_tpu.util import resolve_num_shards as _num_shards
+
+
+def _tbi_enabled(options: Sequence[WriteOption]) -> bool:
+    for o in options:
+        if isinstance(o, TabixIndexWriteOption):
+            return bool(o.value)
+    return False
+
+
 class VcfSink:
+    """Single-file VCF write."""
+
     def __init__(self, storage=None):
         self._storage = storage
 
-    def save(self, dataset, path, options=()):
-        raise NotImplementedError(
-            "VCF write support lands in the next milestone (SURVEY.md §2.7)"
+    def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        fs, path = resolve_path(path)
+        fmt = _format_for(path, options)
+        write_tbi = _tbi_enabled(options)
+        if write_tbi and fmt is not VariantsFormatWriteOption.VCF_BGZ:
+            raise ValueError("tabix (.tbi) requires block-compressed VCF (VCF_BGZ)")
+        batch: VariantBatch = dataset.variants
+        header_bytes = dataset.header.text.encode()
+        temp_dir = next(
+            (o.path for o in options if isinstance(o, TempPartsDirectoryWriteOption)),
+            path + ".parts",
         )
+        n_shards = min(_num_shards(self._storage), max(1, batch.count))
+        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        fs.mkdirs(temp_dir)
+        try:
+            self._write_parts(
+                fs, path, temp_dir, fmt, write_tbi, batch, header_bytes,
+                n_shards, bounds,
+            )
+        finally:
+            fs.delete(temp_dir, recursive=True)
+
+    def _write_parts(
+        self, fs, path, temp_dir, fmt, write_tbi, batch, header_bytes,
+        n_shards, bounds,
+    ) -> None:
+        bgz = fmt is VariantsFormatWriteOption.VCF_BGZ
+        plain_gz = fmt is VariantsFormatWriteOption.VCF_GZ
+        part_paths: List[str] = []
+        part_lens: List[int] = []
+        tbi_frags: List[TbiIndex] = []
+        for k in range(n_shards):
+            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+            lens = np.diff(part.line_offsets)
+            body = _lines_blob(part)
+            if bgz:
+                comp, csizes = deflate_blob(body)
+                if write_tbi:
+                    line_starts = np.zeros(part.count + 1, dtype=np.int64)
+                    np.cumsum(lens + 1, out=line_starts[1:])
+                    block_comp_start = np.zeros(len(csizes) + 1, dtype=np.int64)
+                    np.cumsum(csizes, out=block_comp_start[1:])
+                    bidx = line_starts // BGZF_MAX_PAYLOAD
+                    within = line_starts % BGZF_MAX_PAYLOAD
+                    voffs = (
+                        block_comp_start[bidx].astype(np.uint64) << np.uint64(16)
+                    ) | within.astype(np.uint64)
+                    tbi_frags.append(
+                        build_tbi(
+                            part.contig_names, part.chrom, part.pos,
+                            part.end, voffs[:-1], voffs[1:],
+                        )
+                    )
+                data = comp
+            elif plain_gz:
+                buf = io.BytesIO()
+                # mtime pinned for deterministic output
+                with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as z:
+                    z.write(body)
+                data = buf.getvalue()
+            else:
+                data = body
+            p = os.path.join(temp_dir, f"part-{k:05d}")
+            fs.write_all(p, data)
+            part_paths.append(p)
+            part_lens.append(len(data))
+
+        header_path = os.path.join(temp_dir, "_header")
+        if bgz:
+            hdr, _ = deflate_blob(header_bytes)
+        elif plain_gz:
+            buf = io.BytesIO()
+            with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as z:
+                z.write(header_bytes)
+            hdr = buf.getvalue()
+        else:
+            hdr = header_bytes
+        fs.write_all(header_path, hdr)
+        tail: List[str] = []
+        if bgz:
+            term_path = os.path.join(temp_dir, "_terminator")
+            fs.write_all(term_path, BGZF_EOF_MARKER)
+            tail = [term_path]
+        fs.concat([header_path] + part_paths + tail, path)
+
+        if write_tbi and tbi_frags:
+            part_starts = np.zeros(len(part_lens) + 1, dtype=np.int64)
+            np.cumsum(part_lens, out=part_starts[1:])
+            merged = merge_tbi_fragments(tbi_frags, list(part_starts[:-1] + len(hdr)))
+            fs.write_all(path + ".tbi", merged.to_bytes())
 
 
-class VcfSinkMultiple(VcfSink):
-    pass
+class VcfSinkMultiple:
+    """Directory of complete per-shard VCFs (``MULTIPLE`` cardinality)."""
+
+    def __init__(self, storage=None):
+        self._storage = storage
+
+    def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        fs, path = resolve_path(path)
+        fmt = _format_for("", options)
+        ext = {"vcf": ".vcf", "vcf.gz": ".vcf.gz", "vcf.bgz": ".vcf.bgz"}[fmt.value]
+        batch = dataset.variants
+        n_shards = min(_num_shards(self._storage), max(1, batch.count))
+        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        fs.mkdirs(path)
+        header_bytes = dataset.header.text.encode()
+        for k in range(n_shards):
+            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+            payload = header_bytes + _lines_blob(part)
+            if fmt is VariantsFormatWriteOption.VCF_BGZ:
+                comp, _ = deflate_blob(payload)
+                data = comp + BGZF_EOF_MARKER
+            elif fmt is VariantsFormatWriteOption.VCF_GZ:
+                buf = io.BytesIO()
+                with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as z:
+                    z.write(payload)
+                data = buf.getvalue()
+            else:
+                data = payload
+            fs.write_all(os.path.join(path, f"part-r-{k:05d}{ext}"), data)
+
+
+def _lines_blob(part: VariantBatch) -> bytes:
+    """Part lines + newlines, vectorized (no per-line join)."""
+    n = part.count
+    if n == 0:
+        return b""
+    lens = np.diff(part.line_offsets)
+    out = np.empty(int(lens.sum()) + n, dtype=np.uint8)
+    dst_starts = np.zeros(n, dtype=np.int64)
+    np.cumsum(lens[:-1] + 1, out=dst_starts[1:])
+    seg = np.repeat(np.arange(n), lens)
+    within = np.arange(int(lens.sum()), dtype=np.int64) - part.line_offsets[seg]
+    out[dst_starts[seg] + within] = part.lines
+    out[dst_starts + lens] = ord("\n")
+    return out.tobytes()

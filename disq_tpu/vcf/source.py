@@ -1,8 +1,213 @@
+"""VcfSource — the VCF read path.
+
+Reference parity: ``impl/formats/vcf/VcfSource.java`` (SURVEY.md §2.7,
+call stack §3.4): header parsed host-side; the body read as text splits.
+Compression dispatch mirrors ``BGZFEnhancedGzipCodec``: a ``.gz`` that is
+really BGZF is *splittable* (per-split block-aligned line reading); plain
+gzip falls back to a single split; plain text uses byte-range line
+splits. Interval queries use ``.tbi`` chunk pruning when the index
+exists, then an exact vectorized overlap filter either way.
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from disq_tpu.bgzf.block import BGZF_EOF_MARKER
+from disq_tpu.bgzf.codec import inflate_blocks
+from disq_tpu.bgzf.guesser import BgzfBlockGuesser, _walk_blocks_collect
+from disq_tpu.fsw.filesystem import (
+    FileSystemWrapper,
+    compute_path_splits,
+    resolve_path,
+)
+from disq_tpu.fsw.textsplit import lines_for_split
+from disq_tpu.vcf.columnar import VariantBatch, parse_vcf_lines
+from disq_tpu.vcf.header import read_vcf_header, sniff_compression
+
+
 class VcfSource:
     def __init__(self, storage=None):
         self._storage = storage
 
-    def get_variants(self, path, intervals=None):
-        raise NotImplementedError(
-            "VCF read support lands in the next milestone (SURVEY.md §2.7)"
-        )
+    @property
+    def split_size(self) -> int:
+        return getattr(self._storage, "_split_size", 128 * 1024 * 1024)
+
+    # -- public -------------------------------------------------------------
+
+    def get_variants(self, path: str, intervals=None):
+        from disq_tpu.api import VariantsDataset
+
+        fs, path = resolve_path(path)
+        header = read_vcf_header(fs, path)
+        kind = sniff_compression(fs.read_range(path, 0, 18))
+
+        if intervals is not None and kind == "bgzf" and fs.exists(path + ".tbi"):
+            batch = self._read_with_tabix(fs, path, header, intervals)
+        elif kind == "plain":
+            batch = self._read_plain(fs, path, header)
+        elif kind == "gzip":
+            batch = self._read_whole_gzip(fs, path, header)
+        else:
+            batch = self._read_bgzf(fs, path, header)
+        if intervals is not None:
+            batch = batch.filter(self._overlap_mask(batch, intervals))
+        header = header.with_contigs(list(batch.contig_names))
+        return VariantsDataset(header=header, variants=batch)
+
+    # -- plain text ---------------------------------------------------------
+
+    def _read_plain(self, fs, path, header) -> VariantBatch:
+        batches = []
+        for s in compute_path_splits(fs, path, self.split_size):
+            raw = [
+                ln for ln in lines_for_split(fs, path, s.start, s.end)
+                if ln and not ln.startswith(b"#")
+            ]
+            batches.append(parse_vcf_lines(raw, header.contig_names))
+        return VariantBatch.concat(batches) if batches else VariantBatch.empty(header.contig_names)
+
+    def _read_whole_gzip(self, fs, path, header) -> VariantBatch:
+        # Plain gzip is not splittable: one task reads the whole file
+        # (reference behavior via BGZFEnhancedGzipCodec fallback).
+        with fs.open(path) as f:
+            data = gzip.GzipFile(fileobj=f).read()
+        raw = [
+            ln for ln in data.split(b"\n") if ln and not ln.startswith(b"#")
+        ]
+        return parse_vcf_lines(raw, header.contig_names)
+
+    # -- splittable bgzf ----------------------------------------------------
+
+    def _read_bgzf(self, fs, path, header) -> VariantBatch:
+        length = fs.get_file_length(path)
+        batches = []
+        for s in compute_path_splits(fs, path, self.split_size):
+            raw = self._bgzf_split_lines(fs, path, s.start, s.end, length)
+            raw = [ln for ln in raw if ln and not ln.startswith(b"#")]
+            batches.append(parse_vcf_lines(raw, header.contig_names))
+        return VariantBatch.concat(batches) if batches else VariantBatch.empty(header.contig_names)
+
+    def _bgzf_split_lines(
+        self, fs, path: str, start: int, end: int, length: int
+    ) -> List[bytes]:
+        """Lines owned by this split under the Hadoop discard rule, in
+        decompressed space: a split starting mid-stream discards through
+        its first newline, so the previous split owns every line starting
+        at any position ≤ its region length (including a line that begins
+        exactly AT the region boundary — the neighbor will discard it).
+        Mirrors ``fsw.textsplit.lines_for_split``'s boundary handling."""
+        g = BgzfBlockGuesser(fs, path)
+        first = g.guess_block_start(start)
+        if first is None or first >= end:
+            return []
+        blocks, data = _walk_blocks_collect(fs, path, first, end, length)
+        if not blocks:
+            return []
+        owned = inflate_blocks(data, blocks, base=first)
+        owned_len = len(owned)
+        # Extend with neighbor blocks until a newline appears at-or-past
+        # the owned region end, completing the straddling line (or the
+        # line that starts exactly at the boundary, which we also own).
+        ext = bytearray(owned)
+        next_pos = blocks[-1].end
+        while ext.find(b"\n", owned_len) < 0 and next_pos < length:
+            nxt, ndata = _walk_blocks_collect(
+                fs, path, next_pos, next_pos + 1, length,
+                chunk=2 * 0x10000,  # one max block + header slack, not 8 MiB
+            )
+            if not nxt:
+                break
+            ext += inflate_blocks(ndata, nxt, base=next_pos)
+            next_pos = nxt[-1].end
+        text = bytes(ext)
+        begin = 0
+        if first > 0:
+            # Discard through the first newline: that partial (or
+            # boundary-starting) line belongs to the previous split.
+            nl = text.find(b"\n")
+            if nl < 0 or nl + 1 > owned_len:
+                return []
+            begin = nl + 1
+        out = []
+        pos = begin
+        # Own every line starting at pos <= owned_len (boundary inclusive).
+        while pos <= owned_len:
+            if pos >= len(text):
+                break
+            nl = text.find(b"\n", pos)
+            if nl < 0:
+                tail = text[pos:]
+                if tail:
+                    out.append(tail)
+                break
+            out.append(text[pos:nl])
+            pos = nl + 1
+        return out
+
+    # -- tabix pruning ------------------------------------------------------
+
+    def _read_with_tabix(self, fs, path, header, intervals) -> VariantBatch:
+        from disq_tpu.index.tbi import TbiIndex
+
+        tbi = TbiIndex.from_bytes(fs.read_all(path + ".tbi"))
+        chunks = []
+        for iv in intervals:
+            chunks += tbi.chunks_for_interval(iv.contig, iv.start - 1, iv.end)
+        chunks.sort()
+        merged = []
+        for cb, ce in chunks:
+            if merged and cb <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], ce))
+            else:
+                merged.append((cb, ce))
+        length = fs.get_file_length(path)
+        batches = []
+        for cb, ce in merged:
+            lo_block, lo_u = cb >> 16, cb & 0xFFFF
+            hi_block, hi_u = ce >> 16, ce & 0xFFFF
+            want_end = hi_block + (1 if hi_u > 0 else 0)
+            blocks, data = _walk_blocks_collect(
+                fs, path, lo_block, max(want_end, lo_block + 1), length
+            )
+            if not blocks:
+                continue
+            blob = inflate_blocks(data, blocks, base=lo_block)
+            if hi_u > 0:
+                acc = sum(b.usize for b in blocks if b.pos < hi_block)
+                blob = blob[lo_u: acc + hi_u]
+            else:
+                blob = blob[lo_u:]
+            raw = [
+                ln for ln in blob.split(b"\n") if ln and not ln.startswith(b"#")
+            ]
+            # The final line may be cut by the chunk end; a cut line's
+            # variant starts beyond the interval anyway (chunk ends are
+            # line boundaries in our indexes) — parse defensively.
+            parsed: List[bytes] = []
+            for ln in raw:
+                if ln.count(b"\t") >= 7:
+                    parsed.append(ln)
+            batches.append(parse_vcf_lines(parsed, header.contig_names))
+        if not batches:
+            return VariantBatch.empty(header.contig_names)
+        return VariantBatch.concat(batches)
+
+    @staticmethod
+    def _overlap_mask(batch: VariantBatch, intervals) -> np.ndarray:
+        mask = np.zeros(batch.count, dtype=bool)
+        name_to_id = {n: i for i, n in enumerate(batch.contig_names)}
+        for iv in intervals:
+            ci = name_to_id.get(iv.contig)
+            if ci is None:
+                continue
+            mask |= (
+                (batch.chrom == ci)
+                & (batch.pos <= iv.end)
+                & (batch.end >= iv.start)
+            )
+        return mask

@@ -1,0 +1,146 @@
+"""Text SAM tests: tag codec round trips, line parse, split invariance,
+BAM ⇄ SAM cross-format identity."""
+
+import os
+
+import numpy as np
+import pytest
+
+from disq_tpu import FileCardinalityWriteOption, ReadsFormatWriteOption, ReadsStorage
+from disq_tpu.sam.text import (
+    batch_to_sam_lines,
+    parse_cigar,
+    sam_lines_to_batch,
+    tags_to_text,
+    text_to_tags,
+)
+from disq_tpu.bam.codec import decode_records, encode_records
+from disq_tpu.bam.header import SamHeader
+
+from tests.bam_oracle import DEFAULT_REFS, encode_record, make_bam_bytes, synth_records
+
+
+class TestTagCodec:
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "NM:i:5", "XX:A:Q", "XF:f:3.25", "RG:Z:sample1",
+            "XH:H:1AFF", "XB:B:c,1,-2,3", "XI:B:I,100000,2",
+            "XF:B:f,1.5,-2.25", "XE:B:c",
+        ],
+    )
+    def test_text_round_trip(self, text):
+        binary = text_to_tags([text])
+        assert tags_to_text(binary) == [text]
+
+    def test_binary_small_ints_canonicalize(self):
+        import struct
+
+        raw = b"XAc" + struct.pack("<b", -5) + b"XBS" + struct.pack("<H", 40000)
+        assert tags_to_text(raw) == ["XA:i:-5", "XB:i:40000"]
+
+    def test_cigar(self):
+        assert parse_cigar("*") == []
+        assert parse_cigar("5M") == [(5 << 4)]
+        assert parse_cigar("3S10M2I1D") == [
+            (3 << 4) | 4, (10 << 4), (2 << 4) | 1, (1 << 4) | 2
+        ]
+        with pytest.raises(ValueError):
+            parse_cigar("xyz")
+
+
+class TestLineRoundTrip:
+    def test_batch_to_lines_to_batch(self):
+        header = SamHeader.build(DEFAULT_REFS)
+        records = synth_records(100, seed=8, unmapped_tail=4)
+        blob = b"".join(encode_record(r) for r in records)
+        batch = decode_records(blob)
+        lines = batch_to_sam_lines(batch, header)
+        back = sam_lines_to_batch(lines, header)
+        np.testing.assert_array_equal(back.refid, batch.refid)
+        np.testing.assert_array_equal(back.pos, batch.pos)
+        np.testing.assert_array_equal(back.flag, batch.flag)
+        np.testing.assert_array_equal(back.cigars, batch.cigars)
+        np.testing.assert_array_equal(back.seqs, batch.seqs)
+        for i in (0, 1, 2, 50, 99):
+            assert back.name(i) == batch.name(i)
+
+    def test_mate_equals_shorthand(self):
+        header = SamHeader.build(DEFAULT_REFS)
+        b = sam_lines_to_batch(
+            ["r1\t99\tchr1\t100\t60\t4M\t=\t200\t104\tACGT\tIIII"], header
+        )
+        assert b.next_refid[0] == 0 and b.next_pos[0] == 199
+
+
+class TestSamEndToEnd:
+    @pytest.fixture(scope="class")
+    def sam_file(self, tmp_path_factory):
+        header = SamHeader.build(DEFAULT_REFS)
+        records = synth_records(300, seed=12, unmapped_tail=6)
+        blob = b"".join(encode_record(r) for r in records)
+        batch = decode_records(blob)
+        lines = batch_to_sam_lines(batch, header)
+        path = str(tmp_path_factory.mktemp("sam") / "in.sam")
+        with open(path, "w") as f:
+            f.write(header.text)
+            f.write("".join(ln + "\n" for ln in lines))
+        return path, records
+
+    @pytest.mark.parametrize("split_size", [501, 4096, 10**9])
+    def test_split_invariance(self, sam_file, split_size):
+        path, records = sam_file
+        ds = ReadsStorage.make_default().split_size(split_size).read(path)
+        assert ds.count() == len(records)
+        np.testing.assert_array_equal(ds.reads.pos, [r.pos for r in records])
+        assert ds.header.sequences[0].name == "chr1"
+
+    def test_sam_write_single(self, sam_file, tmp_path):
+        path, records = sam_file
+        st = ReadsStorage.make_default().num_shards(3)
+        ds = st.read(path)
+        out = str(tmp_path / "out.sam")
+        st.write(ds, out)
+        with open(out) as f:
+            content = f.read()
+        assert content.startswith("@HD")
+        body = [l for l in content.splitlines() if not l.startswith("@")]
+        assert len(body) == len(records)
+        # Round-trip through the reader again
+        ds2 = ReadsStorage.make_default().read(out)
+        np.testing.assert_array_equal(ds2.reads.pos, ds.reads.pos)
+
+    def test_sam_write_multiple(self, sam_file, tmp_path):
+        path, records = sam_file
+        st = ReadsStorage.make_default().num_shards(3)
+        ds = st.read(path)
+        out = str(tmp_path / "outdir")
+        st.write(ds, out, FileCardinalityWriteOption.MULTIPLE, ReadsFormatWriteOption.SAM)
+        parts = sorted(os.listdir(out))
+        assert len(parts) == 3 and all(p.endswith(".sam") for p in parts)
+        total = 0
+        for p in parts:
+            ds_p = ReadsStorage.make_default().read(os.path.join(out, p))
+            total += ds_p.count()
+        assert total == len(records)
+
+    def test_bam_to_sam_to_bam_identity(self, tmp_path):
+        """Cross-format: BAM → SAM → BAM preserves record semantics."""
+        records = synth_records(80, seed=13)
+        bam_in = str(tmp_path / "x.bam")
+        with open(bam_in, "wb") as f:
+            f.write(make_bam_bytes(DEFAULT_REFS, records))
+        st = ReadsStorage.make_default().num_shards(2)
+        ds = st.read(bam_in)
+        sam_mid = str(tmp_path / "x.sam")
+        st.write(ds, sam_mid)
+        ds2 = st.read(sam_mid)
+        bam_out = str(tmp_path / "y.bam")
+        st.write(ds2, bam_out)
+        ds3 = st.read(bam_out)
+        np.testing.assert_array_equal(ds3.reads.pos, ds.reads.pos)
+        np.testing.assert_array_equal(ds3.reads.cigars, ds.reads.cigars)
+        np.testing.assert_array_equal(ds3.reads.seqs, ds.reads.seqs)
+        np.testing.assert_array_equal(ds3.reads.quals, ds.reads.quals)
+        for i in (0, 40, 79):
+            assert ds3.reads.name(i) == ds.reads.name(i)

@@ -1,0 +1,191 @@
+"""VCF tests: header parse, plain/gzip/bgzf reads, split invariance,
+single/multiple writes, tabix round-trip, interval queries."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from disq_tpu import (
+    FileCardinalityWriteOption,
+    TabixIndexWriteOption,
+    VariantsFormatWriteOption,
+    VariantsStorage,
+)
+from disq_tpu.api import Interval
+
+from tests.bam_oracle import o_bgzf_compress
+
+CONTIGS = [("chr1", 100_000), ("chr2", 50_000)]
+
+
+def _make_vcf_text(n=500, seed=0, sorted_=True, with_end_info=True):
+    rng = np.random.default_rng(seed)
+    header = (
+        "##fileformat=VCFv4.2\n"
+        + "".join(f"##contig=<ID={c},length={l}>\n" for c, l in CONTIGS)
+        + '##INFO=<ID=END,Number=1,Type=Integer,Description="End">\n'
+        + "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n"
+    )
+    recs = []
+    for i in range(n):
+        ci = int(rng.integers(0, len(CONTIGS)))
+        pos = int(rng.integers(1, CONTIGS[ci][1] - 100))
+        ref = "ACGT"[: int(rng.integers(1, 5))]
+        alt = "T" if ref[0] != "T" else "C"
+        info = "."
+        if with_end_info and i % 37 == 0:
+            info = f"END={pos + 499}"
+        recs.append((ci, pos, f"{CONTIGS[ci][0]}\t{pos}\tid{i}\t{ref}\t{alt}\t50\tPASS\t{info}\tGT\t0/1"))
+    if sorted_:
+        recs.sort(key=lambda t: (t[0], t[1]))
+    body = "".join(line + "\n" for _, _, line in recs)
+    return header, body, recs
+
+
+@pytest.fixture(scope="module")
+def vcf_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("vcf")
+    header, body, recs = _make_vcf_text(500, seed=1)
+    plain = str(d / "a.vcf")
+    open(plain, "w").write(header + body)
+    bgz = str(d / "a.vcf.bgz")
+    open(bgz, "wb").write(o_bgzf_compress((header + body).encode(), blocksize=777))
+    gz = str(d / "a.vcf.gz")
+    open(gz, "wb").write(gzip.compress((header + body).encode()))
+    return plain, bgz, gz, recs
+
+
+class TestRead:
+    def test_header(self, vcf_files):
+        plain, _, _, recs = vcf_files
+        ds = VariantsStorage.make_default().read(plain)
+        assert ds.header.contig_names == ("chr1", "chr2")
+        assert ds.header.samples == ("S1",)
+
+    @pytest.mark.parametrize("which", [0, 1, 2])
+    def test_all_compressions_agree(self, vcf_files, which):
+        paths = vcf_files[:3]
+        recs = vcf_files[3]
+        ds = VariantsStorage.make_default().read(paths[which])
+        assert ds.count() == len(recs)
+        np.testing.assert_array_equal(ds.variants.pos, [p for _, p, _ in recs])
+        np.testing.assert_array_equal(ds.variants.chrom, [c for c, _, _ in recs])
+
+    @pytest.mark.parametrize("split_size", [997, 5000, 10**9])
+    def test_bgzf_split_invariance(self, vcf_files, split_size):
+        _, bgz, _, recs = vcf_files
+        ds = VariantsStorage.make_default().split_size(split_size).read(bgz)
+        assert ds.count() == len(recs)
+        np.testing.assert_array_equal(ds.variants.pos, [p for _, p, _ in recs])
+
+    @pytest.mark.parametrize("split_size", [800, 10**9])
+    def test_plain_split_invariance(self, vcf_files, split_size):
+        plain, _, _, recs = vcf_files
+        ds = VariantsStorage.make_default().split_size(split_size).read(plain)
+        assert ds.count() == len(recs)
+
+    def test_end_info_respected(self, vcf_files):
+        plain, _, _, recs = vcf_files
+        ds = VariantsStorage.make_default().read(plain)
+        v = ds.variants
+        has_end = [i for i in range(v.count) if "END=" in v.line(i)]
+        assert has_end
+        for i in has_end:
+            assert v.end[i] == v.pos[i] + 499
+
+    def test_interval_filter(self, vcf_files):
+        plain, _, _, recs = vcf_files
+        ds = VariantsStorage.make_default().read(
+            plain, intervals=[Interval("chr1", 1, 10_000)]
+        )
+        v = ds.variants
+        assert v.count > 0
+        assert np.all(v.chrom == 0)
+        assert np.all(v.pos <= 10_000)
+
+
+class TestWrite:
+    def test_round_trip_plain(self, vcf_files, tmp_path):
+        plain, _, _, recs = vcf_files
+        st = VariantsStorage.make_default().num_shards(3)
+        ds = st.read(plain)
+        out = str(tmp_path / "o.vcf")
+        st.write(ds, out)
+        content = open(out).read()
+        assert content.startswith("##fileformat")
+        ds2 = st.read(out)
+        np.testing.assert_array_equal(ds2.variants.pos, ds.variants.pos)
+        assert ds2.variants.line(0) == ds.variants.line(0)
+
+    def test_round_trip_bgz_with_tabix(self, vcf_files, tmp_path):
+        _, bgz, _, recs = vcf_files
+        st = VariantsStorage.make_default().num_shards(4)
+        ds = st.read(bgz)
+        out = str(tmp_path / "o.vcf.bgz")
+        st.write(ds, out, TabixIndexWriteOption.ENABLE)
+        assert os.path.exists(out + ".tbi")
+        # gzip oracle: the written file is valid multi-member gzip
+        raw = gzip.decompress(open(out, "rb").read()).decode()
+        assert raw.count("\n") == len(recs) + raw.split("\n").index(
+            [l for l in raw.split("\n") if l.startswith("#CHROM")][0]
+        ) + 1
+        # read back through tabix-pruned interval query
+        ds2 = st.read(out, intervals=[Interval("chr2", 1, 25_000)])
+        brute = st.read(out)
+        mask = (brute.variants.chrom == 1) & (brute.variants.pos <= 25_000)
+        expect = brute.variants.filter(mask)
+        np.testing.assert_array_equal(np.sort(ds2.variants.pos), np.sort(expect.pos))
+
+    def test_round_trip_gz(self, vcf_files, tmp_path):
+        plain, _, _, recs = vcf_files
+        st = VariantsStorage.make_default().num_shards(2)
+        ds = st.read(plain)
+        out = str(tmp_path / "o.vcf.gz")
+        st.write(ds, out)
+        ds2 = st.read(out)
+        assert ds2.count() == len(recs)
+
+    def test_multiple(self, vcf_files, tmp_path):
+        plain, _, _, recs = vcf_files
+        st = VariantsStorage.make_default().num_shards(3)
+        ds = st.read(plain)
+        out = str(tmp_path / "parts")
+        st.write(ds, out, FileCardinalityWriteOption.MULTIPLE)
+        parts = sorted(os.listdir(out))
+        assert len(parts) == 3
+        total = sum(
+            VariantsStorage.make_default().read(os.path.join(out, p)).count()
+            for p in parts
+        )
+        assert total == len(recs)
+
+    def test_tbi_requires_bgz(self, vcf_files, tmp_path):
+        plain, _, _, _ = vcf_files
+        st = VariantsStorage.make_default()
+        ds = st.read(plain)
+        with pytest.raises(ValueError, match="VCF_BGZ"):
+            st.write(ds, str(tmp_path / "x.vcf"), TabixIndexWriteOption.ENABLE)
+
+
+class TestBlockBoundaryOwnership:
+    def test_newline_at_block_boundary_not_lost(self, tmp_path):
+        """Review regression: a BGZF block boundary falling exactly after a
+        newline must not drop the next line at any split size."""
+        header = (
+            "##fileformat=VCFv4.2\n##contig=<ID=chr1,length=100000>\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        lines = [f"chr1\t{p}\t.\tA\tG\t9\tPASS\t." for p in range(1, 201)]
+        body = "\n".join(lines) + "\n"
+        payload = (header + body).encode()
+        # Block size equal to one full line (+newline) so many block
+        # boundaries land exactly after newlines.
+        line_len = len(lines[0]) + 1
+        comp = o_bgzf_compress(payload, blocksize=line_len)
+        p = str(tmp_path / "b.vcf.bgz")
+        open(p, "wb").write(comp)
+        for split_size in range(300, 420, 7):
+            ds = VariantsStorage.make_default().split_size(split_size).read(p)
+            assert ds.count() == 200, f"split_size={split_size} lost records"
