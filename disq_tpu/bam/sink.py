@@ -38,7 +38,7 @@ from disq_tpu.bgzf.codec import compress_to_bgzf, deflate_blob
 from disq_tpu.fsw.filesystem import FileSystemWrapper, resolve_path
 from disq_tpu.index.bai import BaiIndex, build_bai, merge_bai_fragments
 from disq_tpu.index.sbi import SbiIndex
-from disq_tpu.util import resolve_num_shards
+from disq_tpu.util import resolve_num_shards, shard_bounds
 
 SBI_GRANULARITY = 4096  # htsjdk SBIIndexWriter default
 
@@ -93,8 +93,7 @@ class BamSink:
                 "sort first (ReadsStorage.write(..., sort=True))"
             )
 
-        n_shards = min(self._num_shards(), max(1, batch.count))
-        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(temp_dir)
         try:
             self._write_parts_and_merge(
@@ -169,9 +168,7 @@ class BamSinkMultiple:
         fs, path = resolve_path(path)
         header: SamHeader = dataset.header
         batch: ReadBatch = dataset.reads
-        sink = BamSink(self._storage)
-        n_shards = min(sink._num_shards(), max(1, batch.count))
-        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         header_bytes = header.to_bam_bytes()
         for k in range(n_shards):

@@ -1,0 +1,134 @@
+"""CRAM low-level IO: ITF8 / LTF8 varints and byte cursors.
+
+Replaces htsjdk's ``ITF8``/``LTF8``/``CramInt`` utilities (the CRAM 3.0
+spec §2.3 integer encodings used throughout container/block headers).
+
+ITF8: up to 5 bytes; the number of leading 1-bits in the first byte
+(before the first 0) gives the count of additional bytes. LTF8: same
+scheme for 64-bit values, up to 9 bytes.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+
+def write_itf8(value: int) -> bytes:
+    v = value & 0xFFFFFFFF
+    if v < 0x80:
+        return bytes([v])
+    if v < 0x4000:
+        return bytes([0x80 | (v >> 8), v & 0xFF])
+    if v < 0x200000:
+        return bytes([0xC0 | (v >> 16), (v >> 8) & 0xFF, v & 0xFF])
+    if v < 0x10000000:
+        return bytes([
+            0xE0 | (v >> 24), (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF
+        ])
+    return bytes([
+        0xF0 | ((v >> 28) & 0x0F), (v >> 20) & 0xFF, (v >> 12) & 0xFF,
+        (v >> 4) & 0xFF, v & 0x0F,
+    ])
+
+
+def read_itf8(data, offset: int) -> Tuple[int, int]:
+    """→ (value as signed int32, new offset)."""
+    b0 = data[offset]
+    if b0 < 0x80:
+        v, off = b0, offset + 1
+    elif b0 < 0xC0:
+        v = ((b0 & 0x7F) << 8) | data[offset + 1]
+        off = offset + 2
+    elif b0 < 0xE0:
+        v = ((b0 & 0x3F) << 16) | (data[offset + 1] << 8) | data[offset + 2]
+        off = offset + 3
+    elif b0 < 0xF0:
+        v = (
+            ((b0 & 0x1F) << 24) | (data[offset + 1] << 16)
+            | (data[offset + 2] << 8) | data[offset + 3]
+        )
+        off = offset + 4
+    else:
+        v = (
+            ((b0 & 0x0F) << 28) | (data[offset + 1] << 20)
+            | (data[offset + 2] << 12) | (data[offset + 3] << 4)
+            | (data[offset + 4] & 0x0F)
+        )
+        off = offset + 5
+    if v >= 1 << 31:
+        v -= 1 << 32
+    return v, off
+
+
+def write_ltf8(value: int) -> bytes:
+    v = value & 0xFFFFFFFFFFFFFFFF
+    if v < 0x80:
+        return bytes([v])
+    for extra in range(1, 8):
+        # `extra` additional bytes carry 8*extra bits; the first byte
+        # (extra leading ones, then 0) carries 7-extra more.
+        if v < 1 << (7 + 7 * extra):
+            lead = (0xFF << (8 - extra)) & 0xFF
+            first = lead | (v >> (8 * extra))
+            rest = [(v >> (8 * (extra - 1 - k))) & 0xFF for k in range(extra)]
+            return bytes([first] + rest)
+    return bytes([0xFF]) + struct.pack(">Q", v)
+
+
+def read_ltf8(data, offset: int) -> Tuple[int, int]:
+    b0 = data[offset]
+    # count leading ones
+    ones = 0
+    while ones < 8 and (b0 << ones) & 0x80:
+        ones += 1
+    if ones == 0:
+        v, off = b0, offset + 1
+    elif ones == 8:
+        (v,) = struct.unpack_from(">Q", bytes(data[offset + 1: offset + 9]), 0)
+        off = offset + 9
+    else:
+        v = b0 & (0x7F >> ones)
+        for k in range(ones):
+            v = (v << 8) | data[offset + 1 + k]
+        off = offset + 1 + ones
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v, off
+
+
+class Cursor:
+    """Sequential reader over a bytes-like object."""
+
+    def __init__(self, data, offset: int = 0):
+        self.data = data
+        self.off = offset
+
+    def itf8(self) -> int:
+        v, self.off = read_itf8(self.data, self.off)
+        return v
+
+    def ltf8(self) -> int:
+        v, self.off = read_ltf8(self.data, self.off)
+        return v
+
+    def bytes(self, n: int) -> bytes:
+        b = bytes(self.data[self.off: self.off + n])
+        if len(b) != n:
+            raise ValueError("truncated CRAM stream")
+        self.off += n
+        return b
+
+    def u8(self) -> int:
+        v = self.data[self.off]
+        self.off += 1
+        return v
+
+    def i32(self) -> int:
+        (v,) = struct.unpack_from("<i", self.data, self.off)
+        self.off += 4
+        return v
+
+    def itf8_array(self) -> List[int]:
+        n = self.itf8()
+        return [self.itf8() for _ in range(n)]

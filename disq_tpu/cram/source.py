@@ -1,10 +1,147 @@
+"""CramSource — the parallel CRAM read path.
+
+Reference parity: ``impl/formats/cram/CramSource.java`` (SURVEY.md §2.5,
+call stack §3.5): container start offsets are enumerated by walking
+container headers (payloads skipped — cheap, seek-dominated); containers
+are assigned to byte-range splits by the "container start in [start,
+end)" first-owner rule; each split decodes its containers with the
+reference supplied via ``reference_source_path`` (REQUIRED for
+reference-compressed data, as in the reference). Interval traversal
+prunes containers through ``.crai`` when present.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+from disq_tpu.bam.columnar import ReadBatch
+from disq_tpu.bam.header import SamHeader
+from disq_tpu.cram.codec import decode_container_records
+from disq_tpu.cram.crai import CraiIndex
+from disq_tpu.cram.io import Cursor
+from disq_tpu.cram.structure import (
+    Block,
+    ContainerHeader,
+    FILE_HEADER,
+    read_container_header_at,
+    read_file_definition,
+    walk_container_offsets,
+)
+from disq_tpu.fsw.filesystem import (
+    FileSystemWrapper,
+    compute_path_splits,
+    resolve_path,
+)
+
+
+def read_cram_header(fs: FileSystemWrapper, path: str) -> SamHeader:
+    """SAM header from the first (FILE_HEADER) container."""
+    head = fs.read_range(path, 0, min(fs.get_file_length(path), 1 << 20))
+    _, off = read_file_definition(head)
+    cur = Cursor(head, off)
+    hdr = ContainerHeader.read(cur)
+    need = cur.off + hdr.length
+    if need > len(head):
+        head = fs.read_range(path, 0, need)
+        cur = Cursor(head, off)
+        hdr = ContainerHeader.read(cur)
+    block = Block.read(cur)
+    if block.content_type != FILE_HEADER:
+        raise ValueError("first CRAM container does not hold the SAM header")
+    (l_text,) = struct.unpack_from("<i", block.data, 0)
+    text = block.data[4:4 + l_text].decode(errors="replace").rstrip("\x00")
+    return SamHeader.from_text(text)
+
+
 class CramSource:
     def __init__(self, storage=None):
         self._storage = storage
 
-    def get_reads(self, path, traversal=None):
-        raise NotImplementedError(
-            "CRAM read support is not built yet in this milestone "
-            "(planned: container walk + rANS/gzip block codecs, "
-            "SURVEY.md §2.5)"
+    @property
+    def split_size(self) -> int:
+        return getattr(self._storage, "_split_size", 128 * 1024 * 1024)
+
+    def _ref_fetch(self, header: SamHeader):
+        from disq_tpu.cram.refsource import fetcher_for_storage
+
+        return fetcher_for_storage(self._storage, header)
+
+    # -- public -------------------------------------------------------------
+
+    def get_reads(self, path: str, traversal=None):
+        from disq_tpu.api import ReadsDataset
+
+        fs, path = resolve_path(path)
+        header = read_cram_header(fs, path)
+        ref_fetch = self._ref_fetch(header)
+        containers = walk_container_offsets(fs, path)
+        data_containers = [
+            (off, hdr) for off, hdr in containers[1:] if not hdr.is_eof
+        ]
+        if traversal is not None:
+            batch = self._read_with_traversal(
+                fs, path, header, ref_fetch, data_containers, traversal
+            )
+            return ReadsDataset(header=header, reads=batch)
+        batches = []
+        for s in compute_path_splits(fs, path, self.split_size):
+            owned = [
+                (off, hdr) for off, hdr in data_containers
+                if s.start <= off < s.end
+            ]
+            for off, hdr in owned:
+                batches.append(self._decode_at(fs, path, off, ref_fetch))
+        return ReadsDataset(header=header, reads=ReadBatch.concat(batches))
+
+    # -- internals ----------------------------------------------------------
+
+    def _decode_at(self, fs, path: str, offset: int, ref_fetch) -> ReadBatch:
+        hdr, hdr_size = read_container_header_at(
+            fs, path, offset, fs.get_file_length(path)
         )
+        blocks = fs.read_range(path, offset + hdr_size, hdr.length)
+        return decode_container_records(blocks, ref_fetch)
+
+    def _read_with_traversal(
+        self, fs, path, header, ref_fetch, data_containers, traversal
+    ) -> ReadBatch:
+        batches: List[ReadBatch] = []
+        crai: Optional[CraiIndex] = None
+        if fs.exists(path + ".crai"):
+            crai = CraiIndex.from_bytes(fs.read_all(path + ".crai"))
+        if traversal.intervals is not None and len(traversal.intervals) > 0:
+            if crai is not None:
+                offsets = set()
+                for iv in traversal.intervals:
+                    refid = header.ref_index(iv.contig)
+                    offsets.update(
+                        crai.containers_for_interval(refid, iv.start, iv.end)
+                    )
+                chosen = sorted(offsets)
+            else:
+                chosen = [off for off, _ in data_containers]
+            sub = []
+            for off in chosen:
+                sub.append(self._decode_at(fs, path, off, ref_fetch))
+            if sub:
+                merged = ReadBatch.concat(sub)
+                from disq_tpu.traversal.bai_query import overlap_mask
+
+                batches.append(
+                    merged.filter(overlap_mask(merged, header, traversal.intervals))
+                )
+        if traversal.traverse_unplaced_unmapped:
+            unmapped_offs = (
+                [e.container_offset for e in crai.entries if e.seq_id == -1]
+                if crai is not None
+                else [off for off, hdr in data_containers if hdr.ref_seq_id == -1]
+            )
+            for off in sorted(set(unmapped_offs)):
+                sub = self._decode_at(fs, path, off, ref_fetch)
+                batches.append(sub.filter(sub.refid == -1))
+        if not batches:
+            return ReadBatch.empty()
+        return ReadBatch.concat(batches)

@@ -41,9 +41,9 @@ class SamFormat(enum.Enum):
 
             return BamSink(storage) if single else BamSinkMultiple(storage)
         if self is SamFormat.CRAM:
-            from disq_tpu.cram.sink import CramSink
+            from disq_tpu.cram.sink import CramSink, CramSinkMultiple
 
-            return CramSink(storage)
+            return CramSink(storage) if single else CramSinkMultiple(storage)
         from disq_tpu.sam.sink import SamSink, SamSinkMultiple
 
         return SamSink(storage) if single else SamSinkMultiple(storage)

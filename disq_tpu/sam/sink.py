@@ -17,7 +17,7 @@ from disq_tpu.fsw.filesystem import resolve_path
 from disq_tpu.sam.text import batch_to_sam_lines
 
 
-from disq_tpu.util import resolve_num_shards as _num_shards
+from disq_tpu.util import shard_bounds
 
 
 class SamSink:
@@ -31,8 +31,7 @@ class SamSink:
             path + ".parts",
         )
         batch = dataset.reads
-        n_shards = min(_num_shards(self._storage), max(1, batch.count))
-        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(temp_dir)
         try:
             header_path = os.path.join(temp_dir, "_header")
@@ -57,8 +56,7 @@ class SamSinkMultiple:
     def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
         fs, path = resolve_path(path)
         batch = dataset.reads
-        n_shards = min(_num_shards(self._storage), max(1, batch.count))
-        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         header_text = dataset.header.text
         for k in range(n_shards):

@@ -51,7 +51,8 @@ def tags_to_text(tags: bytes) -> List[str]:
             p += size
         elif typ == "f":
             (v,) = struct.unpack_from("<f", tags, p)
-            out.append(f"{tag}:f:{v:g}")
+            # shortest float32 round-trip formatting (no %g truncation)
+            out.append(f"{tag}:f:{np.float32(v)}")
             p += 4
         elif typ in "ZH":
             end = tags.index(b"\x00", p)
@@ -63,7 +64,7 @@ def tags_to_text(tags: bytes) -> List[str]:
             fmt, size = _B_SUBTYPES[sub]
             vals = struct.unpack_from(f"<{cnt}{fmt}", tags, p + 5)
             body = ",".join(
-                f"{v:g}" if sub == "f" else str(v) for v in vals
+                str(np.float32(v)) if sub == "f" else str(v) for v in vals
             )
             out.append(f"{tag}:B:{sub}{',' + body if cnt else ''}")
             p += 5 + cnt * size
@@ -81,7 +82,13 @@ def text_to_tags(fields: Iterable[str]) -> bytes:
         if typ == "A":
             out += b"A" + val.encode()
         elif typ == "i":
-            out += b"i" + struct.pack("<i", int(val))
+            v = int(val)
+            if -(1 << 31) <= v < (1 << 31):
+                out += b"i" + struct.pack("<i", v)
+            elif v < (1 << 32):
+                out += b"I" + struct.pack("<I", v)
+            else:
+                raise ValueError(f"integer tag value out of range: {v}")
         elif typ == "f":
             out += b"f" + struct.pack("<f", float(val))
         elif typ in ("Z", "H"):

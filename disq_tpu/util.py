@@ -16,3 +16,13 @@ def resolve_num_shards(storage) -> int:
         return len(jax.devices())
     except Exception:
         return 1
+
+
+def shard_bounds(storage, count: int):
+    """(n_shards, bounds) for partitioning ``count`` records across write
+    shards — single source of truth for every sink."""
+    import numpy as np
+
+    n_shards = min(resolve_num_shards(storage), max(1, count))
+    bounds = np.linspace(0, count, n_shards + 1).astype(np.int64)
+    return n_shards, bounds

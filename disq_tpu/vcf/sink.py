@@ -45,7 +45,7 @@ def _format_for(path: str, options: Sequence[WriteOption]) -> VariantsFormatWrit
     return VariantsFormatWriteOption.VCF
 
 
-from disq_tpu.util import resolve_num_shards as _num_shards
+from disq_tpu.util import shard_bounds
 
 
 def _tbi_enabled(options: Sequence[WriteOption]) -> bool:
@@ -73,8 +73,7 @@ class VcfSink:
             (o.path for o in options if isinstance(o, TempPartsDirectoryWriteOption)),
             path + ".parts",
         )
-        n_shards = min(_num_shards(self._storage), max(1, batch.count))
-        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(temp_dir)
         try:
             self._write_parts(
@@ -165,8 +164,7 @@ class VcfSinkMultiple:
         fmt = _format_for("", options)
         ext = {"vcf": ".vcf", "vcf.gz": ".vcf.gz", "vcf.bgz": ".vcf.bgz"}[fmt.value]
         batch = dataset.variants
-        n_shards = min(_num_shards(self._storage), max(1, batch.count))
-        bounds = np.linspace(0, batch.count, n_shards + 1).astype(np.int64)
+        n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         header_bytes = dataset.header.text.encode()
         for k in range(n_shards):

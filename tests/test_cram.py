@@ -1,0 +1,243 @@
+"""CRAM 3.0 tests: varints, rANS, structure round-trip, reference-based
+and reference-less read/write, crai traversal, split invariance."""
+
+import os
+
+import numpy as np
+import pytest
+
+from disq_tpu import (
+    CraiWriteOption,
+    FileCardinalityWriteOption,
+    ReadsFormatWriteOption,
+    ReadsStorage,
+    TraversalParameters,
+)
+from disq_tpu.api import Interval
+from disq_tpu.bam.codec import decode_records
+from disq_tpu.cram.io import read_itf8, read_ltf8, write_itf8, write_ltf8
+from disq_tpu.cram.rans import rans_decode, rans_encode_order0
+from disq_tpu.cram.refsource import CramReferenceSource, write_fasta
+from disq_tpu.cram.structure import EOF_CONTAINER, ContainerHeader
+from disq_tpu.cram.io import Cursor
+from disq_tpu.fsw import PosixFileSystemWrapper
+
+from tests.bam_oracle import DEFAULT_REFS, ORecord, encode_record, make_bam_bytes, synth_records
+
+FS = PosixFileSystemWrapper()
+
+
+class TestPrimitives:
+    @pytest.mark.parametrize("v", [0, 1, 127, 128, 0x3FFF, 0x4000, 0x1FFFFF,
+                                    0x10000000, 0x7FFFFFFF, -1, -7])
+    def test_itf8(self, v):
+        enc = write_itf8(v)
+        dec, off = read_itf8(enc, 0)
+        assert dec == v and off == len(enc)
+
+    @pytest.mark.parametrize("shift", list(range(0, 63, 7)))
+    def test_ltf8(self, shift):
+        for v in ((1 << shift) - 1, 1 << shift, (1 << shift) + 1):
+            enc = write_ltf8(v)
+            dec, off = read_ltf8(enc, 0)
+            assert dec == v and off == len(enc)
+
+    def test_rans_round_trip(self):
+        rng = np.random.default_rng(2)
+        for data in [b"", b"x", b"qualqualqual" * 500,
+                     rng.integers(30, 40, 20000, dtype=np.uint8).tobytes()]:
+            assert rans_decode(rans_encode_order0(data)) == data
+
+    def test_eof_container_parses(self):
+        cur = Cursor(EOF_CONTAINER)
+        hdr = ContainerHeader.read(cur)
+        assert hdr.is_eof
+
+
+@pytest.fixture(scope="module")
+def ref_fasta(tmp_path_factory):
+    """A FASTA matching DEFAULT_REFS contig sizes."""
+    d = tmp_path_factory.mktemp("ref")
+    rng = np.random.default_rng(99)
+    contigs = [
+        (name, rng.choice(list(b"ACGT"), size).astype(np.uint8).tobytes())
+        for name, size in DEFAULT_REFS
+    ]
+    path = str(d / "ref.fa")
+    write_fasta(FS, path, contigs)
+    return path, dict(contigs)
+
+
+def _synth_ref_matched(ref_seqs, n=200, seed=5, mismatch_rate=0.2):
+    """Records whose M-run bases come FROM the reference (so the writer
+    can omit them), with a fraction carrying deliberate mismatches."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    names = [n_ for n_, _ in DEFAULT_REFS]
+    for i in range(n):
+        ci = int(rng.integers(0, len(names)))
+        seq_ref = ref_seqs[names[ci]]
+        readlen = int(rng.integers(30, 120))
+        pos = int(rng.integers(0, len(seq_ref) - readlen - 1))
+        bases = bytearray(seq_ref[pos: pos + readlen])
+        cigar = [(readlen, "M")]
+        if rng.random() < 0.3:
+            sc = int(rng.integers(1, 8))
+            cigar = [(sc, "S"), (readlen - sc, "M")]
+            bases[:sc] = rng.choice(list(b"ACGT"), sc).astype(np.uint8).tobytes()
+        if rng.random() < mismatch_rate:
+            k = int(rng.integers(0, readlen))
+            bases[k] = ord("A") if bases[k] != ord("A") else ord("C")
+        recs.append(
+            ORecord(
+                name=f"cr{i:05d}", refid=ci, pos=pos,
+                mapq=int(rng.integers(0, 60)), flag=0, cigar=cigar,
+                seq=bytes(bases).decode(),
+                qual=bytes(rng.integers(0, 40, readlen, dtype=np.uint8).tolist()),
+                tags=b"NMC\x01" if rng.random() < 0.5 else b"",
+            )
+        )
+    recs.sort(key=lambda r: (r.refid, r.pos))
+    for i in range(6):
+        recs.append(ORecord(name=f"unm{i}", refid=-1, pos=-1, flag=4,
+                            seq="ACGTA", qual=b"\x11" * 5))
+    return recs
+
+
+@pytest.fixture(scope="module")
+def bam_input(tmp_path_factory, ref_fasta):
+    _, ref_seqs = ref_fasta
+    recs = _synth_ref_matched(ref_seqs)
+    path = str(tmp_path_factory.mktemp("cram") / "in.bam")
+    with open(path, "wb") as f:
+        f.write(make_bam_bytes(DEFAULT_REFS, recs, sort_order="coordinate"))
+    return path, recs
+
+
+class TestCramRoundTrip:
+    def test_with_reference(self, bam_input, ref_fasta, tmp_path):
+        bam, recs = bam_input
+        ref, _ = ref_fasta
+        st = ReadsStorage.make_default().reference_source_path(ref).num_shards(3)
+        ds = st.read(bam)
+        out = str(tmp_path / "o.cram")
+        st.write(ds, out, CraiWriteOption.ENABLE)
+        assert open(out, "rb").read().endswith(EOF_CONTAINER)
+        assert os.path.exists(out + ".crai")
+        ds2 = st.read(out)
+        self._assert_equal(ds, ds2)
+
+    def test_without_reference(self, bam_input, tmp_path):
+        """No reference: all bases embedded verbatim; read needs no ref."""
+        bam, recs = bam_input
+        st = ReadsStorage.make_default().num_shards(2)
+        ds = st.read(bam)
+        out = str(tmp_path / "noref.cram")
+        st.write(ds, out)
+        ds2 = ReadsStorage.make_default().read(out)
+        self._assert_equal(ds, ds2)
+
+    def test_ref_compressed_requires_ref_to_read(self, bam_input, ref_fasta, tmp_path):
+        bam, _ = bam_input
+        ref, _ = ref_fasta
+        st = ReadsStorage.make_default().reference_source_path(ref)
+        ds = st.read(bam)
+        out = str(tmp_path / "rr.cram")
+        st.write(ds, out)
+        with pytest.raises(ValueError, match="reference"):
+            ReadsStorage.make_default().read(out)  # no ref configured
+
+    @pytest.mark.parametrize("split_size", [2000, 10**9])
+    def test_split_invariance(self, bam_input, ref_fasta, tmp_path, split_size):
+        bam, _ = bam_input
+        ref, _ = ref_fasta
+        st = ReadsStorage.make_default().reference_source_path(ref).num_shards(4)
+        ds = st.read(bam)
+        out = str(tmp_path / "s.cram")
+        st.write(ds, out)
+        ds2 = (
+            ReadsStorage.make_default()
+            .reference_source_path(ref)
+            .split_size(split_size)
+            .read(out)
+        )
+        self._assert_equal(ds, ds2)
+
+    def test_multiple_cardinality(self, bam_input, ref_fasta, tmp_path):
+        bam, _ = bam_input
+        ref, _ = ref_fasta
+        st = ReadsStorage.make_default().reference_source_path(ref).num_shards(3)
+        ds = st.read(bam)
+        out = str(tmp_path / "dir")
+        st.write(ds, out, FileCardinalityWriteOption.MULTIPLE, ReadsFormatWriteOption.CRAM)
+        parts = sorted(os.listdir(out))
+        assert len(parts) == 3 and all(p.endswith(".cram") for p in parts)
+        total = sum(
+            ReadsStorage.make_default().reference_source_path(ref)
+            .read(os.path.join(out, p)).count()
+            for p in parts
+        )
+        assert total == ds.count()
+
+    @staticmethod
+    def _assert_equal(ds, ds2):
+        a, b = ds.reads, ds2.reads
+        assert b.count == a.count
+        np.testing.assert_array_equal(b.refid, a.refid)
+        np.testing.assert_array_equal(b.pos, a.pos)
+        np.testing.assert_array_equal(b.flag, a.flag)
+        np.testing.assert_array_equal(b.mapq, a.mapq)
+        np.testing.assert_array_equal(b.cigars, a.cigars)
+        np.testing.assert_array_equal(b.cigar_offsets, a.cigar_offsets)
+        np.testing.assert_array_equal(b.seqs, a.seqs)
+        np.testing.assert_array_equal(b.quals, a.quals)
+        np.testing.assert_array_equal(b.tags, a.tags)
+        np.testing.assert_array_equal(b.tlen, a.tlen)
+        for i in (0, a.count // 2, a.count - 1):
+            assert b.name(i) == a.name(i)
+
+
+class TestCramTraversal:
+    def test_interval_query_via_crai(self, bam_input, ref_fasta, tmp_path):
+        bam, _ = bam_input
+        ref, _ = ref_fasta
+        st = ReadsStorage.make_default().reference_source_path(ref).num_shards(3)
+        ds = st.read(bam)
+        out = str(tmp_path / "t.cram")
+        st.write(ds, out, CraiWriteOption.ENABLE)
+        iv = Interval("chr1", 1, 50_000)
+        sub = st.read(out, TraversalParameters(intervals=[iv]))
+        ends = ds.reads.alignment_ends()
+        mask = (ds.reads.refid == 0) & (ds.reads.pos < 50_000) & (ends > 0)
+        assert sub.count() == int(mask.sum())
+
+    def test_unmapped_traversal(self, bam_input, ref_fasta, tmp_path):
+        bam, _ = bam_input
+        ref, _ = ref_fasta
+        st = ReadsStorage.make_default().reference_source_path(ref).num_shards(2)
+        ds = st.read(bam)
+        out = str(tmp_path / "u.cram")
+        st.write(ds, out, CraiWriteOption.ENABLE)
+        sub = st.read(
+            out, TraversalParameters(intervals=[], traverse_unplaced_unmapped=True)
+        )
+        assert sub.count() == int((ds.reads.refid == -1).sum())
+
+
+class TestRefSource:
+    def test_fai_roundtrip(self, ref_fasta):
+        path, contigs = ref_fasta
+        src = CramReferenceSource(FS, path)
+        for name, seq in contigs.items():
+            assert src.contig_length(name) == len(seq)
+            assert src.bases_by_name(name, 100, 50) == seq[100:150]
+
+    def test_fasta_without_fai(self, ref_fasta, tmp_path):
+        path, contigs = ref_fasta
+        import shutil
+
+        p2 = str(tmp_path / "nofai.fa")
+        shutil.copy(path, p2)
+        src = CramReferenceSource(FS, p2)
+        name = next(iter(contigs))
+        assert src.bases_by_name(name, 0, 30) == contigs[name][:30]

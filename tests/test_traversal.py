@@ -126,8 +126,9 @@ class TestRegressionsFromReview:
         # the stale last data block start.
         assert (r.tell_virtual() >> 16) >= len(comp) - 28
 
-    def test_unimplemented_formats_raise_cleanly(self, tmp_path):
-        # CRAM is the one remaining stub; it must fail with a clear
-        # NotImplementedError, not a ModuleNotFoundError.
-        with pytest.raises(NotImplementedError, match="CRAM"):
-            ReadsStorage.make_default().read("x.cram")
+    def test_all_formats_dispatch(self):
+        # Every format in the matrix resolves to a real source; missing
+        # files fail with FileNotFoundError, not dispatch errors.
+        for ext in (".bam", ".sam", ".cram"):
+            with pytest.raises(FileNotFoundError):
+                ReadsStorage.make_default().read("definitely-missing" + ext)
