@@ -31,6 +31,8 @@ import enum
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple, Union
 
+import numpy as np
+
 
 class WriteOption:
     """Marker base for varargs write options (ref: ``WriteOption.java``)."""
@@ -123,6 +125,23 @@ class ReadsDataset:
 
         header = self.header.with_sort_order("coordinate")
         return ReadsDataset(header=header, reads=coordinate_sort_batch(self.reads))
+
+    # -- device analytics ---------------------------------------------------
+
+    def flagstat(self, mesh=None) -> dict:
+        """Per-category read counts (``samtools flagstat`` equivalent),
+        computed on device; with a mesh, sharded + psum-reduced."""
+        from disq_tpu.ops.flagstat import flagstat_counts
+
+        return flagstat_counts(np.asarray(self.reads.flag), mesh=mesh)
+
+    def depth(self, window: int = 1024) -> dict:
+        """Windowed coverage depth per reference (device scatter+cumsum)."""
+        from disq_tpu.ops.depth import window_depth
+
+        return window_depth(
+            self.reads, [s.length for s in self.header.sequences], window
+        )
 
 
 @dataclass

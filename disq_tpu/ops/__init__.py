@@ -1,0 +1,3 @@
+from disq_tpu.ops.parse import parse_fixed_words, parse_fixed_words_pallas  # noqa: F401
+from disq_tpu.ops.flagstat import flagstat_counts, FLAGSTAT_FIELDS  # noqa: F401
+from disq_tpu.ops.depth import window_depth  # noqa: F401

@@ -1,0 +1,100 @@
+"""flagstat — per-category read counts (the ``samtools flagstat``
+equivalent), computed on device from the columnar flag/mapq arrays.
+
+Single-chip: one fused jnp pass. Multi-chip: the same op under
+``shard_map`` with a ``psum`` over the mesh axis — counts are the
+canonical "reduce over shards" pattern (SURVEY.md §5: counters returned
+per shard and reduced).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FLAGSTAT_FIELDS = (
+    "total", "secondary", "supplementary", "duplicates", "mapped",
+    "paired", "read1", "read2", "proper_pair", "with_mate_mapped",
+    "singletons", "qc_fail",
+)
+
+
+def _counts(flag, valid):
+    """samtools-flagstat semantics: pair-related categories count only
+    PRIMARY records (secondary 0x100 and supplementary 0x800 excluded),
+    and 'with itself and mate mapped' requires the read itself mapped."""
+    f = flag.astype(jnp.int32)
+    v = valid.astype(jnp.int32)
+
+    def c(hit):
+        return jnp.sum(hit.astype(jnp.int32) * v)
+
+    primary = ((f & (0x100 | 0x800)) == 0)
+    paired = primary & ((f & 0x1) != 0)
+    self_mapped = (f & 0x4) == 0
+    mate_unmapped = (f & 0x8) != 0
+    return jnp.stack(
+        [
+            jnp.sum(v),
+            c((f & 0x100) != 0),                     # secondary
+            c((f & 0x800) != 0),                     # supplementary
+            c((f & 0x400) != 0),                     # duplicates
+            c(self_mapped),                          # mapped
+            c(paired),                               # paired
+            c(paired & ((f & 0x40) != 0)),           # read1
+            c(paired & ((f & 0x80) != 0)),           # read2
+            c(paired & ((f & 0x2) != 0) & self_mapped),  # proper pair
+            c(paired & self_mapped & ~mate_unmapped),    # with mate mapped
+            c(paired & self_mapped & mate_unmapped),     # singletons
+            c((f & 0x200) != 0),                     # qc fail
+        ]
+    )
+
+
+@jax.jit
+def _flagstat_single(flag: jax.Array) -> jax.Array:
+    return _counts(flag, jnp.ones(flag.shape, jnp.int32))
+
+
+def flagstat_counts(
+    flag: np.ndarray, mesh: Optional[Mesh] = None, axis: str = "shards"
+) -> Dict[str, int]:
+    """flag column → category counts. With a mesh, the column is sharded
+    over it and the reduction is a psum over ICI."""
+    if mesh is None or mesh.shape[axis] <= 1 or len(flag) == 0:
+        out = _flagstat_single(jnp.asarray(flag.astype(np.int32)))
+        return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, np.asarray(out))}
+    n_shards = mesh.shape[axis]
+    per = -(-len(flag) // n_shards)
+    padded = np.zeros(per * n_shards, dtype=np.int32)
+    padded[: len(flag)] = flag
+    validity = np.zeros(per * n_shards, dtype=np.int32)
+    validity[: len(flag)] = 1
+    sharding = NamedSharding(mesh, P(axis, None))
+    fd = jax.device_put(padded.reshape(n_shards, per), sharding)
+    vd = jax.device_put(validity.reshape(n_shards, per), sharding)
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    def body(f, v):
+        local = _counts(f.reshape(-1), v.reshape(-1))
+        return lax.psum(local, axis)[None]
+
+    out = jax.jit(
+        shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None)),
+            out_specs=P(axis, None),
+        )
+    )(fd, vd)
+    row = np.asarray(out)[0]
+    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}
