@@ -128,12 +128,12 @@ class ReadsDataset:
 
     # -- device analytics ---------------------------------------------------
 
-    def flagstat(self, mesh=None) -> dict:
+    def flagstat(self, mesh=None, axis: str = "shards") -> dict:
         """Per-category read counts (``samtools flagstat`` equivalent),
         computed on device; with a mesh, sharded + psum-reduced."""
         from disq_tpu.ops.flagstat import flagstat_counts
 
-        return flagstat_counts(np.asarray(self.reads.flag), mesh=mesh)
+        return flagstat_counts(np.asarray(self.reads.flag), mesh=mesh, axis=axis)
 
     def depth(self, window: int = 1024) -> dict:
         """Windowed coverage depth per reference (device scatter+cumsum)."""

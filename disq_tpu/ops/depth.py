@@ -42,6 +42,12 @@ def window_depth(
     ref_win_off = np.zeros(len(ref_lengths) + 1, dtype=np.int64)
     np.cumsum(n_win_per_ref, out=ref_win_off[1:])
     total_windows = int(ref_win_off[-1])
+    if total_windows + 1 > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"total window count {total_windows} exceeds int32 scatter-index "
+            f"range; use a larger window than {window} for these reference "
+            "lengths"
+        )
 
     sel = (batch.refid >= 0) & (batch.refid < len(ref_lengths)) & (
         (batch.flag & 0x4) == 0

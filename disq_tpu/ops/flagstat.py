@@ -67,6 +67,14 @@ def flagstat_counts(
 ) -> Dict[str, int]:
     """flag column → category counts. With a mesh, the column is sharded
     over it and the reduction is a psum over ICI."""
+    if mesh is not None and axis not in mesh.axis_names:
+        if len(mesh.axis_names) == 1:
+            axis = mesh.axis_names[0]
+        else:
+            raise ValueError(
+                f"axis {axis!r} not in mesh axes {mesh.axis_names}; pass "
+                "axis= explicitly for multi-axis meshes"
+            )
     if mesh is None or mesh.shape[axis] <= 1 or len(flag) == 0:
         out = _flagstat_single(jnp.asarray(flag.astype(np.int32)))
         return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, np.asarray(out))}
@@ -87,14 +95,14 @@ def flagstat_counts(
 
     def body(f, v):
         local = _counts(f.reshape(-1), v.reshape(-1))
-        return lax.psum(local, axis)[None]
+        return lax.psum(local, axis)
 
     out = jax.jit(
         shard_map(
             body, mesh=mesh,
             in_specs=(P(axis, None), P(axis, None)),
-            out_specs=P(axis, None),
+            out_specs=P(),
         )
     )(fd, vd)
-    row = np.asarray(out)[0]
+    row = np.asarray(out)
     return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}
