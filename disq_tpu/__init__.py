@@ -40,4 +40,13 @@ from disq_tpu.api import (  # noqa: F401
     SbiWriteOption,
     CraiWriteOption,
     TabixIndexWriteOption,
+    StageManifestWriteOption,
+)
+from disq_tpu.runtime import (  # noqa: F401
+    PipelineCounters,
+    ShardCounters,
+    StageManifest,
+    phase_report,
+    reduce_counters,
+    trace_phase,
 )

@@ -63,6 +63,18 @@ class TempPartsDirectoryWriteOption(WriteOption):
     path: str
 
 
+@dataclass(frozen=True)
+class StageManifestWriteOption(WriteOption):
+    """Enable the restartable write protocol (SURVEY.md §5): per-shard
+    progress is checkpointed to a stage-manifest JSON at ``path``; a
+    crashed write re-run with the same manifest re-executes only the
+    missing shards, and staged parts survive failures until the merge
+    commit point. Beyond reference parity — Spark got this from task
+    retry + lineage."""
+
+    path: str
+
+
 class BaiWriteOption(WriteOption, enum.Enum):
     ENABLE = True
     DISABLE = False
@@ -112,10 +124,14 @@ class TraversalParameters:
 
 @dataclass
 class ReadsDataset:
-    """Header + sharded columnar read batch (ref: ``HtsjdkReadsRdd.java``)."""
+    """Header + sharded columnar read batch (ref: ``HtsjdkReadsRdd.java``).
+
+    ``counters``, when present, holds the reduced per-shard decode
+    counters (records/blocks/bytes/compression ratio; SURVEY.md §5)."""
 
     header: "SamHeader"
     reads: "ReadBatch"
+    counters: object = None
 
     def count(self) -> int:
         return int(self.reads.count)

@@ -27,6 +27,7 @@ import numpy as np
 from disq_tpu.api import (
     BaiWriteOption,
     SbiWriteOption,
+    StageManifestWriteOption,
     TempPartsDirectoryWriteOption,
     WriteOption,
 )
@@ -41,6 +42,34 @@ from disq_tpu.index.sbi import SbiIndex
 from disq_tpu.util import resolve_num_shards, shard_bounds
 
 SBI_GRANULARITY = 4096  # htsjdk SBIIndexWriter default
+
+
+def _batch_digest(batch) -> int:
+    """Content fingerprint for resume-safety: a manifest written against
+    one dataset must not adopt staged parts encoded from another. CRC32
+    over every column (one vectorized pass; ~GB/s, negligible next to
+    deflate)."""
+    import zlib
+
+    crc = 0
+    for col in (
+        batch.refid, batch.pos, batch.mapq, batch.flag, batch.tlen,
+        batch.names, batch.cigars, batch.seqs, batch.quals, batch.tags,
+    ):
+        crc = zlib.crc32(np.ascontiguousarray(col).tobytes(), crc)
+    return crc
+
+
+def _pickle_dumps(obj) -> bytes:
+    import pickle
+
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _pickle_loads(data: bytes):
+    import pickle
+
+    return pickle.loads(data)
 
 
 def _opt_enabled(options: Sequence[WriteOption], cls, default: bool) -> bool:
@@ -93,57 +122,140 @@ class BamSink:
                 "sort first (ReadsStorage.write(..., sort=True))"
             )
 
+        manifest = None
+        manifest_opt = next(
+            (o for o in options if isinstance(o, StageManifestWriteOption)), None
+        )
         n_shards, bounds = shard_bounds(self._storage, batch.count)
+        if manifest_opt is not None:
+            from disq_tpu.runtime import StageManifest
+
+            manifest = StageManifest(
+                manifest_opt.path,
+                params={
+                    "target": path,
+                    "records": int(batch.count),
+                    "digest": _batch_digest(batch),
+                    "n_shards": int(n_shards),
+                    "bai": write_bai,
+                    "sbi": write_sbi,
+                },
+            )
         fs.mkdirs(temp_dir)
         try:
             self._write_parts_and_merge(
                 fs, header, batch, path, temp_dir, n_shards, bounds,
-                write_bai, write_sbi,
+                write_bai, write_sbi, manifest,
             )
-        finally:
+        except BaseException:
             # Idempotent write protocol (SURVEY.md §5): the merge is the
-            # commit point; the staging dir never outlives save(), whether
-            # it succeeds or raises.
+            # commit point. Without a manifest the staging dir never
+            # outlives save(); with one, staged parts survive the failure
+            # so a re-run resumes shard-level instead of starting over.
+            if manifest is None:
+                fs.delete(temp_dir, recursive=True)
+            raise
+        else:
+            # Commit order matters: retire the manifest FIRST. A crash
+            # between the two steps then leaks only a stale staging dir
+            # (harmless; recreated next run) rather than a manifest whose
+            # recorded part paths no longer exist.
+            if manifest is not None:
+                manifest.finish()
             fs.delete(temp_dir, recursive=True)
+
+    def _write_one_part(
+        self, fs, header, batch, temp_dir, bounds, write_bai, write_sbi, k,
+        frag_cache=None,
+    ) -> dict:
+        """Encode + deflate + stage shard ``k``; returns the shard's
+        manifest record (part path/length + index-fragment locations).
+        Fragments land in ``frag_cache`` in memory and are additionally
+        pickled next to the part only when checkpointing (frag_cache is
+        None ⇒ persist — the manifest path always resumes from disk)."""
+        from disq_tpu.runtime import check_voffsets, debug_enabled
+
+        part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+        blob, rec_offs = encode_records_with_offsets(part)
+        comp, voffs, end_voffs = bgzf_compress_with_voffsets(blob, rec_offs)
+        if debug_enabled():
+            check_voffsets(voffs)
+        part_path = os.path.join(temp_dir, f"part-{k:05d}")
+        fs.write_all(part_path, comp)
+        info = {"part": part_path, "len": len(comp), "sbi": None, "bai": None}
+        persist = frag_cache is None
+        sbi_frag = bai_frag = None
+        if write_sbi:
+            sbi_frag = SbiIndex.build(
+                voffs, int(end_voffs[-1]) if part.count else 0,
+                0, granularity=SBI_GRANULARITY,
+            )
+            info["sbi"] = part_path + ".sbi-frag"
+            if persist:
+                fs.write_all(info["sbi"], _pickle_dumps(sbi_frag))
+        if write_bai:
+            bai_frag = build_bai(
+                part.refid, part.pos, part.alignment_ends(),
+                part.flag, voffs, end_voffs, header.n_ref,
+            )
+            info["bai"] = part_path + ".bai-frag"
+            if persist:
+                fs.write_all(info["bai"], _pickle_dumps(bai_frag))
+        if frag_cache is not None:
+            frag_cache[k] = (sbi_frag, bai_frag)
+        return info
 
     def _write_parts_and_merge(
         self, fs, header, batch, path, temp_dir, n_shards, bounds,
-        write_bai, write_sbi,
+        write_bai, write_sbi, manifest=None,
     ) -> None:
-        part_paths: List[str] = []
-        part_lens: List[int] = []
-        sbi_frags: List[SbiIndex] = []
-        bai_frags: List[BaiIndex] = []
-        for k in range(n_shards):
-            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-            blob, rec_offs = encode_records_with_offsets(part)
-            comp, voffs, end_voffs = bgzf_compress_with_voffsets(blob, rec_offs)
-            part_path = os.path.join(temp_dir, f"part-{k:05d}")
-            fs.write_all(part_path, comp)
-            part_paths.append(part_path)
-            part_lens.append(len(comp))
-            if write_sbi:
-                sbi_frags.append(
-                    SbiIndex.build(
-                        voffs, int(end_voffs[-1]) if part.count else 0,
-                        0, granularity=SBI_GRANULARITY,
-                    )
+        from disq_tpu.runtime import trace_phase
+
+        frag_cache: dict = {}
+
+        with trace_phase("bam.write.parts"):
+            if manifest is not None:
+                # Checkpointed: fragments must survive the process, so
+                # each shard pickles them beside its part (frag_cache
+                # unused); resumed shards reload from disk below.
+                infos = manifest.run_stage(
+                    "bam.parts", n_shards,
+                    lambda k: self._write_one_part(
+                        fs, header, batch, temp_dir, bounds,
+                        write_bai, write_sbi, k,
+                    ),
                 )
-            if write_bai:
-                bai_frags.append(
-                    build_bai(
-                        part.refid, part.pos, part.alignment_ends(),
-                        part.flag, voffs, end_voffs, header.n_ref,
+            else:
+                infos = [
+                    self._write_one_part(
+                        fs, header, batch, temp_dir, bounds,
+                        write_bai, write_sbi, k, frag_cache=frag_cache,
                     )
-                )
+                    for k in range(n_shards)
+                ]
+        part_paths = [i["part"] for i in infos]
+        part_lens = [i["len"] for i in infos]
+
+        def _frag(k: int, which: int, key: str):
+            if k in frag_cache:
+                return frag_cache[k][which]
+            return _pickle_loads(fs.read_all(infos[k][key]))
+
+        sbi_frags = [
+            _frag(k, 0, "sbi") for k in range(n_shards) if infos[k]["sbi"]
+        ]
+        bai_frags = [
+            _frag(k, 1, "bai") for k in range(n_shards) if infos[k]["bai"]
+        ]
 
         # Driver side: header-only BGZF prefix, concat, terminator.
-        header_comp = compress_to_bgzf(header.to_bam_bytes(), with_terminator=False)
-        header_path = os.path.join(temp_dir, "_header")
-        fs.write_all(header_path, header_comp)
-        term_path = os.path.join(temp_dir, "_terminator")
-        fs.write_all(term_path, BGZF_EOF_MARKER)
-        fs.concat([header_path] + part_paths + [term_path], path)
+        with trace_phase("bam.write.merge"):
+            header_comp = compress_to_bgzf(header.to_bam_bytes(), with_terminator=False)
+            header_path = os.path.join(temp_dir, "_header")
+            fs.write_all(header_path, header_comp)
+            term_path = os.path.join(temp_dir, "_terminator")
+            fs.write_all(term_path, BGZF_EOF_MARKER)
+            fs.concat([header_path] + part_paths + [term_path], path)
 
         part_starts = np.zeros(len(part_lens) + 1, dtype=np.int64)
         np.cumsum(part_lens, out=part_starts[1:])

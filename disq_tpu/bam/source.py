@@ -47,6 +47,7 @@ def read_header(fs: FileSystemWrapper, path: str) -> Tuple[SamHeader, int]:
 class BamSource:
     def __init__(self, storage=None):
         self._storage = storage
+        self._last_counters = []
 
     @property
     def split_size(self) -> int:
@@ -56,16 +57,32 @@ class BamSource:
 
     def get_reads(self, path: str, traversal=None):
         from disq_tpu.api import ReadsDataset
+        from disq_tpu.runtime import (
+            check_read_batch,
+            debug_enabled,
+            reduce_counters,
+            trace_phase,
+        )
 
         fs, path = resolve_path(path)
-        header, first_voffset = read_header(fs, path)
+        with trace_phase("bam.read.header"):
+            header, first_voffset = read_header(fs, path)
         if traversal is not None:
             from disq_tpu.traversal.bai_query import read_with_traversal
 
-            batch = read_with_traversal(fs, path, header, traversal, self)
+            with trace_phase("bam.read.traversal"):
+                batch = read_with_traversal(fs, path, header, traversal, self)
             return ReadsDataset(header=header, reads=batch)
-        batches = self.read_split_batches(fs, path, header, first_voffset)
-        return ReadsDataset(header=header, reads=ReadBatch.concat(batches))
+        with trace_phase("bam.read.splits"):
+            batches = self.read_split_batches(fs, path, header, first_voffset)
+            batch = ReadBatch.concat(batches)
+        if debug_enabled():
+            check_read_batch(batch, n_ref=header.n_ref)
+        return ReadsDataset(
+            header=header,
+            reads=batch,
+            counters=reduce_counters(self._last_counters),
+        )
 
     # -- split machinery ----------------------------------------------------
 
@@ -79,13 +96,30 @@ class BamSource:
     ) -> List[ReadBatch]:
         """One columnar batch per split — the unit that maps 1:1 onto
         device shards in the distributed pipeline."""
+        import time
+
+        from disq_tpu.runtime import ShardCounters
+
         splits = compute_path_splits(fs, path, split_size or self.split_size)
         sbi = self._try_load_sbi(fs, path)
         boundaries = self._split_boundaries(fs, path, header, first_voffset, splits, sbi)
         out = []
+        self._last_counters = []
         for i in range(len(splits)):
             lo, hi = boundaries[i], boundaries[i + 1]
-            out.append(self._decode_range(fs, path, header, lo, hi))
+            t0 = time.perf_counter()
+            batch, stats = self._decode_range_with_stats(fs, path, header, lo, hi)
+            self._last_counters.append(
+                ShardCounters(
+                    shard_id=i,
+                    records=batch.count,
+                    blocks=stats[0],
+                    bytes_compressed=stats[1],
+                    bytes_uncompressed=stats[2],
+                    wall_seconds=time.perf_counter() - t0,
+                )
+            )
+            out.append(batch)
         return out
 
     def _try_load_sbi(self, fs: FileSystemWrapper, path: str) -> Optional[SbiIndex]:
@@ -177,13 +211,30 @@ class BamSource:
         lo_voffset: int,
         hi_voffset: int,
     ) -> ReadBatch:
+        return self._decode_range_with_stats(
+            fs, path, header, lo_voffset, hi_voffset
+        )[0]
+
+    def _decode_range_with_stats(
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        header: SamHeader,
+        lo_voffset: int,
+        hi_voffset: int,
+    ) -> Tuple[ReadBatch, Tuple[int, int, int]]:
         """Decode all records whose start lies in [lo, hi) virtual space.
 
         Reads compressed blocks from lo's block through hi's block — i.e.
         past the split's byte-range end when a record straddles it.
+        Returns (batch, (blocks, compressed bytes, uncompressed bytes))
+        where the stats count only blocks *owned* by this range —
+        ``pos ∈ [lo_block, hi_block)`` — so a block straddling a split
+        boundary is attributed to exactly one side and reduced totals
+        match the file.
         """
         if hi_voffset <= lo_voffset:
-            return ReadBatch.empty()
+            return ReadBatch.empty(), (0, 0, 0)
         lo_block, lo_u = lo_voffset >> 16, lo_voffset & 0xFFFF
         hi_block, hi_u = hi_voffset >> 16, hi_voffset & 0xFFFF
         length = fs.get_file_length(path)
@@ -194,7 +245,17 @@ class BamSource:
             fs, path, lo_block, max(want_end, lo_block + 1), length
         )
         if not blocks:
-            return ReadBatch.empty()
+            return ReadBatch.empty(), (0, 0, 0)
+        # Consecutive split ranges partition [first_block, data_end) in
+        # block space, so this never under/over-counts across a whole read
+        # (a sub-block range owns nothing: its block belongs to whichever
+        # range starts at or before the block's start).
+        owned = [b for b in blocks if b.pos < hi_block]
+        stats = (
+            len(owned),
+            sum(b.csize for b in owned),
+            sum(b.usize for b in owned),
+        )
         blob = inflate_blocks(data, blocks, base=lo_block)
         if hi_u > 0:
             acc_before_hi = sum(b.usize for b in blocks if b.pos < hi_block)
@@ -203,4 +264,4 @@ class BamSource:
             end_u = len(blob)
         record_bytes = np.frombuffer(blob, dtype=np.uint8)[lo_u:end_u]
         offsets = scan_record_offsets(record_bytes)
-        return decode_records(record_bytes, offsets, n_ref=header.n_ref)
+        return decode_records(record_bytes, offsets, n_ref=header.n_ref), stats

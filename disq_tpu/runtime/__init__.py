@@ -1,0 +1,31 @@
+"""Runtime auxiliary subsystems (SURVEY.md §5).
+
+The reference delegates failure handling to Spark (task retry, lineage
+re-execution) and contributes only the idempotent temp-dir write
+protocol. JAX has no task retry, so the equivalents here are:
+
+- ``manifest`` — a deterministic, restartable *stage manifest* on disk:
+  which shard ranges have been decoded/sorted/written, with shard-level
+  re-execution on restart and the same temp-dir commit protocol.
+- ``counters`` — per-shard counters (records, blocks, bytes,
+  compression ratio) returned per shard and reduced.
+- ``tracing`` — phase wrappers around ``jax.profiler`` traces plus
+  wall-clock structured logs (``DISQ_TPU_TRACE_DIR`` emits perfetto
+  traces).
+- ``debug`` — a debug mode (``DISQ_TPU_DEBUG=1``) asserting
+  shard-boundary invariants (record counts, offset monotonicity)
+  after each phase.
+"""
+
+from disq_tpu.runtime.counters import (  # noqa: F401
+    PipelineCounters,
+    ShardCounters,
+    reduce_counters,
+)
+from disq_tpu.runtime.manifest import StageManifest  # noqa: F401
+from disq_tpu.runtime.tracing import trace_phase, phase_report  # noqa: F401
+from disq_tpu.runtime.debug import (  # noqa: F401
+    debug_enabled,
+    check_read_batch,
+    check_voffsets,
+)

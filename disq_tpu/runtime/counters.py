@@ -1,0 +1,60 @@
+"""Per-shard counters, returned per shard and reduced (SURVEY.md §5).
+
+The reference surfaces record counts only through user-level Spark
+accumulators; disq_tpu makes them first-class: every source/sink shard
+can fill a ``ShardCounters``, and ``reduce_counters`` folds them into
+pipeline totals (records, blocks, bytes in/out, compression ratio).
+On-device reductions (e.g. flagstat's psum) remain separate — these are
+host-side bookkeeping for observability, not data-path state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from typing import Dict, Iterable
+
+
+@dataclass
+class ShardCounters:
+    shard_id: int = -1
+    records: int = 0
+    blocks: int = 0
+    bytes_compressed: int = 0
+    bytes_uncompressed: int = 0
+    wall_seconds: float = 0.0
+
+    def as_dict(self) -> Dict[str, float]:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+@dataclass
+class PipelineCounters:
+    shards: int = 0
+    records: int = 0
+    blocks: int = 0
+    bytes_compressed: int = 0
+    bytes_uncompressed: int = 0
+    wall_seconds: float = 0.0
+
+    @property
+    def compression_ratio(self) -> float:
+        if self.bytes_compressed == 0:
+            return 0.0
+        return self.bytes_uncompressed / self.bytes_compressed
+
+    def as_dict(self) -> Dict[str, float]:
+        d = {f.name: getattr(self, f.name) for f in fields(self)}
+        d["compression_ratio"] = round(self.compression_ratio, 4)
+        return d
+
+
+def reduce_counters(shard_counters: Iterable[ShardCounters]) -> PipelineCounters:
+    total = PipelineCounters()
+    for c in shard_counters:
+        total.shards += 1
+        total.records += c.records
+        total.blocks += c.blocks
+        total.bytes_compressed += c.bytes_compressed
+        total.bytes_uncompressed += c.bytes_uncompressed
+        total.wall_seconds += c.wall_seconds
+    return total

@@ -1,0 +1,129 @@
+"""Stage manifest — deterministic, restartable phase plan.
+
+The reference gets fault tolerance for free from Spark (task retry +
+lineage re-execution, SURVEY.md §5) and adds an idempotent write
+protocol: parts staged to a temp dir, driver merge as the commit point.
+disq_tpu keeps the commit protocol and replaces Spark's retry with a
+*stage manifest*: a JSON file on disk recording, per named stage, which
+shards have completed and any small result payload (part path, length,
+counters). A restarted run re-executes only the missing shards; the
+commit step runs once all shards of the final stage are present.
+
+The manifest is written atomically (tmp file + rename) after every
+shard completion, so a crash at any point leaves a consistent file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+FORMAT_VERSION = 1
+
+
+class StageManifest:
+    """Shard-level checkpoint ledger for a multi-stage pipeline run.
+
+    Keyed by ``(stage, shard_id)``. The optional ``params`` fingerprint
+    guards against resuming with different inputs: if the stored
+    fingerprint differs from the current one, the manifest is reset.
+    """
+
+    def __init__(self, path: str, params: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self._state: Dict[str, Any] = {
+            "version": FORMAT_VERSION,
+            "params": params or {},
+            "stages": {},
+        }
+        if os.path.exists(path):
+            with open(path, "r") as f:
+                stored = json.load(f)
+            if stored.get("version") != FORMAT_VERSION or (
+                params is not None and stored.get("params") != params
+            ):
+                # Incompatible resume: start fresh (old manifest is
+                # replaced on the next _flush).
+                pass
+            else:
+                self._state = stored
+
+    # -- persistence -----------------------------------------------------
+
+    def _flush(self) -> None:
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".manifest-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._state, f)
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    # -- shard ledger ----------------------------------------------------
+
+    def _stage(self, stage: str) -> Dict[str, Any]:
+        return self._state["stages"].setdefault(stage, {"shards": {}})
+
+    def is_done(self, stage: str, shard_id: int) -> bool:
+        return str(shard_id) in self._stage(stage)["shards"]
+
+    def shard_info(self, stage: str, shard_id: int) -> Any:
+        return self._stage(stage)["shards"][str(shard_id)]
+
+    def mark_done(self, stage: str, shard_id: int, info: Any = None) -> None:
+        self._stage(stage)["shards"][str(shard_id)] = info
+        self._flush()
+
+    def completed_shards(self, stage: str) -> List[int]:
+        return sorted(int(k) for k in self._stage(stage)["shards"])
+
+    # -- stage execution -------------------------------------------------
+
+    def run_stage(
+        self,
+        stage: str,
+        n_shards: int,
+        fn: Callable[[int], Any],
+        retries: int = 1,
+    ) -> List[Any]:
+        """Run ``fn(shard_id)`` for every shard not already recorded as
+        done, retrying each failed shard up to ``retries`` extra times
+        (the analogue of Spark task retry). Returns the per-shard info
+        list in shard order, mixing cached and fresh results.
+
+        ``fn``'s return value must be JSON-serializable (it is stored in
+        the manifest and returned verbatim on resume).
+        """
+        out: List[Any] = [None] * n_shards
+        for k in range(n_shards):
+            if self.is_done(stage, k):
+                out[k] = self.shard_info(stage, k)
+                continue
+            last: Optional[BaseException] = None
+            for _attempt in range(retries + 1):
+                try:
+                    info = fn(k)
+                    last = None
+                    break
+                except Exception as e:  # noqa: BLE001 — shard-level retry
+                    last = e
+            if last is not None:
+                raise RuntimeError(
+                    f"stage {stage!r} shard {k} failed after "
+                    f"{retries + 1} attempts"
+                ) from last
+            self.mark_done(stage, k, info)
+            out[k] = info
+        return out
+
+    def finish(self) -> None:
+        """Commit point reached: remove the manifest (the staged parts'
+        directory is cleaned separately by the caller)."""
+        if os.path.exists(self.path):
+            os.unlink(self.path)

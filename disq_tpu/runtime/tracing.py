@@ -1,0 +1,98 @@
+"""Phase tracing — ``jax.profiler`` wrappers + structured wall-clock log.
+
+The reference's observability is the Spark UI plus slf4j loggers
+(SURVEY.md §5); here each pipeline phase is wrapped in
+``trace_phase(name)``:
+
+- always: wall-clock timing, accumulated in a process-local registry
+  readable via ``phase_report()`` and logged at DEBUG level;
+- under a profiler capture: a ``jax.profiler.TraceAnnotation`` so the
+  phase shows up on the XLA timeline;
+- with ``DISQ_TPU_TRACE_DIR`` set (or ``start_trace(dir)`` called), a
+  perfetto/tensorboard trace of everything between the first phase
+  entered and process exit (or ``stop_trace()``) is written there.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Tuple
+
+logger = logging.getLogger("disq_tpu.tracing")
+
+_lock = threading.Lock()
+_phases: List[Tuple[str, float]] = []
+_trace_active = False
+
+
+def start_trace(trace_dir: str) -> None:
+    """Begin a ``jax.profiler`` capture writing to ``trace_dir``."""
+    global _trace_active
+    try:
+        import jax
+    except ImportError:
+        logger.warning("DISQ_TPU_TRACE_DIR set but jax unavailable; no trace")
+        return
+
+    with _lock:
+        if _trace_active:
+            return
+        jax.profiler.start_trace(trace_dir)
+        _trace_active = True
+        atexit.register(stop_trace)
+
+
+def stop_trace() -> None:
+    global _trace_active
+    import jax
+
+    with _lock:
+        if not _trace_active:
+            return
+        jax.profiler.stop_trace()
+        _trace_active = False
+
+
+@contextlib.contextmanager
+def trace_phase(name: str) -> Iterator[None]:
+    trace_dir = os.environ.get("DISQ_TPU_TRACE_DIR")
+    if trace_dir and not _trace_active:
+        start_trace(trace_dir)
+    try:
+        import jax
+
+        annotation = jax.profiler.TraceAnnotation(f"disq_tpu.{name}")
+    except ImportError:  # host-only deployments: timing still works
+        annotation = contextlib.nullcontext()
+
+    t0 = time.perf_counter()
+    with annotation:
+        yield
+    dt = time.perf_counter() - t0
+    with _lock:
+        _phases.append((name, dt))
+    logger.debug("phase %s: %.4fs", name, dt)
+
+
+def phase_report() -> Dict[str, Dict[str, float]]:
+    """Aggregated {phase: {calls, total_s}} since process start."""
+    out: Dict[str, Dict[str, float]] = {}
+    with _lock:
+        snapshot = list(_phases)
+    for name, dt in snapshot:
+        agg = out.setdefault(name, {"calls": 0, "total_s": 0.0})
+        agg["calls"] += 1
+        agg["total_s"] += dt
+    for agg in out.values():
+        agg["total_s"] = round(agg["total_s"], 6)
+    return out
+
+
+def reset_phase_report() -> None:
+    with _lock:
+        _phases.clear()

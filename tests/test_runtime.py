@@ -1,0 +1,251 @@
+"""Runtime aux subsystems (SURVEY.md §5): stage-manifest checkpoint /
+resume, per-shard counters, phase tracing, debug invariants."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bam_oracle import DEFAULT_REFS, make_bam_bytes, parse_bam, synth_records
+from disq_tpu.api import (
+    BaiWriteOption,
+    ReadsStorage,
+    SbiWriteOption,
+    StageManifestWriteOption,
+)
+from disq_tpu.runtime import (
+    ShardCounters,
+    StageManifest,
+    check_read_batch,
+    check_voffsets,
+    phase_report,
+    reduce_counters,
+    trace_phase,
+)
+
+
+# -- manifest ---------------------------------------------------------------
+
+
+def test_manifest_records_and_resumes(tmp_path):
+    m = StageManifest(str(tmp_path / "m.json"), params={"a": 1})
+    calls = []
+
+    def work(k):
+        calls.append(k)
+        return {"k": k * 10}
+
+    out = m.run_stage("s", 4, work)
+    assert [o["k"] for o in out] == [0, 10, 20, 30]
+    assert calls == [0, 1, 2, 3]
+
+    # A fresh manifest object over the same file skips completed shards.
+    m2 = StageManifest(str(tmp_path / "m.json"), params={"a": 1})
+    calls.clear()
+    out2 = m2.run_stage("s", 4, work)
+    assert calls == []
+    assert [o["k"] for o in out2] == [0, 10, 20, 30]
+
+
+def test_manifest_partial_failure_then_resume(tmp_path):
+    path = str(tmp_path / "m.json")
+    m = StageManifest(path)
+    ran = []
+
+    def flaky(k):
+        ran.append(k)
+        if k == 2:
+            raise IOError("disk on fire")
+        return k
+
+    with pytest.raises(RuntimeError, match="shard 2"):
+        m.run_stage("s", 4, flaky, retries=0)
+    # Shards 0 and 1 are checkpointed; resume runs only 2 and 3.
+    ran.clear()
+    out = StageManifest(path).run_stage("s", 4, lambda k: k)
+    assert out == [0, 1, 2, 3]
+
+
+def test_manifest_retry_succeeds(tmp_path):
+    m = StageManifest(str(tmp_path / "m.json"))
+    attempts = {0: 0}
+
+    def flaky_once(k):
+        attempts[0] += 1
+        if attempts[0] == 1:
+            raise IOError("transient")
+        return "ok"
+
+    assert m.run_stage("s", 1, flaky_once, retries=1) == ["ok"]
+
+
+def test_manifest_params_mismatch_resets(tmp_path):
+    path = str(tmp_path / "m.json")
+    m = StageManifest(path, params={"target": "a.bam"})
+    m.mark_done("s", 0, "x")
+    m2 = StageManifest(path, params={"target": "b.bam"})
+    assert not m2.is_done("s", 0)
+
+
+def test_manifest_finish_removes_file(tmp_path):
+    path = str(tmp_path / "m.json")
+    m = StageManifest(path)
+    m.mark_done("s", 0)
+    assert os.path.exists(path)
+    m.finish()
+    assert not os.path.exists(path)
+
+
+# -- restartable BAM write --------------------------------------------------
+
+
+def test_bam_write_resumes_from_manifest(tmp_path, monkeypatch):
+    from disq_tpu.bam.sink import BamSink
+
+    recs = synth_records(3000, seed=5, sorted_coord=True)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs, sort_order="coordinate"))
+    st = ReadsStorage.make_default().num_shards(4)
+    ds = st.read(str(src))
+
+    out = str(tmp_path / "out.bam")
+    mpath = str(tmp_path / "write.manifest")
+    orig = BamSink._write_one_part
+    fail_at = {"k": 2}
+
+    def sabotaged(self, fs, header, batch, temp_dir, bounds, wb, ws, k):
+        if k == fail_at["k"]:
+            raise IOError("injected")
+        return orig(self, fs, header, batch, temp_dir, bounds, wb, ws, k)
+
+    monkeypatch.setattr(BamSink, "_write_one_part", sabotaged)
+    with pytest.raises(RuntimeError, match="shard 2"):
+        st.write(ds, out, StageManifestWriteOption(mpath),
+                 BaiWriteOption.ENABLE, SbiWriteOption.ENABLE)
+    # Staged parts + manifest survive the failure.
+    assert os.path.exists(mpath)
+    assert os.path.exists(out + ".parts/part-00000")
+
+    # Resume: only shards 2..3 re-run.
+    ran = []
+
+    def counting(self, fs, header, batch, temp_dir, bounds, wb, ws, k):
+        ran.append(k)
+        return orig(self, fs, header, batch, temp_dir, bounds, wb, ws, k)
+
+    monkeypatch.setattr(BamSink, "_write_one_part", counting)
+    st.write(ds, out, StageManifestWriteOption(mpath),
+             BaiWriteOption.ENABLE, SbiWriteOption.ENABLE)
+    assert ran == [2, 3]
+    assert not os.path.exists(mpath)          # commit removed it
+    assert not os.path.exists(out + ".parts") # staging cleaned
+
+    _, _, got = parse_bam(open(out, "rb").read())
+    assert len(got) == 3000
+    assert os.path.exists(out + ".bai") and os.path.exists(out + ".sbi")
+    # The resumed file must be identical to a clean one-shot write.
+    clean = str(tmp_path / "clean.bam")
+    monkeypatch.setattr(BamSink, "_write_one_part", orig)
+    st.write(ds, clean, BaiWriteOption.ENABLE, SbiWriteOption.ENABLE)
+    assert open(out, "rb").read() == open(clean, "rb").read()
+    assert open(out + ".bai", "rb").read() == open(clean + ".bai", "rb").read()
+
+
+# -- counters ---------------------------------------------------------------
+
+
+def test_reduce_counters():
+    total = reduce_counters(
+        [
+            ShardCounters(0, records=10, blocks=2, bytes_compressed=100,
+                          bytes_uncompressed=400),
+            ShardCounters(1, records=5, blocks=1, bytes_compressed=50,
+                          bytes_uncompressed=200),
+        ]
+    )
+    assert total.shards == 2
+    assert total.records == 15
+    assert total.blocks == 3
+    assert total.compression_ratio == 4.0
+
+
+def test_read_populates_counters(tmp_path):
+    recs = synth_records(2000, seed=9)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    ds = ReadsStorage.make_default().split_size(40_000).read(str(src))
+    c = ds.counters
+    assert c is not None
+    assert c.records == 2000
+    assert c.shards >= 2              # split_size forced multiple shards
+    assert c.blocks > 0
+    assert c.bytes_uncompressed > c.bytes_compressed > 0
+    assert c.compression_ratio > 1.0
+    # Boundary blocks are attributed to exactly one shard, so the
+    # compressed total can never exceed the file itself.
+    assert c.bytes_compressed <= os.path.getsize(src)
+
+
+# -- tracing ----------------------------------------------------------------
+
+
+def test_trace_phase_report():
+    from disq_tpu.runtime.tracing import reset_phase_report
+
+    reset_phase_report()
+    with trace_phase("unit.phase"):
+        pass
+    with trace_phase("unit.phase"):
+        pass
+    rep = phase_report()
+    assert rep["unit.phase"]["calls"] == 2
+    assert rep["unit.phase"]["total_s"] >= 0
+
+
+def test_read_records_phases(tmp_path):
+    from disq_tpu.runtime.tracing import reset_phase_report
+
+    reset_phase_report()
+    recs = synth_records(100, seed=1)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    ReadsStorage.make_default().read(str(src))
+    rep = phase_report()
+    assert "bam.read.header" in rep and "bam.read.splits" in rep
+
+
+# -- debug invariants -------------------------------------------------------
+
+
+def test_check_read_batch_passes_on_real_batch(tmp_path):
+    recs = synth_records(500, seed=2)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    ds = ReadsStorage.make_default().read(str(src))
+    check_read_batch(ds.reads, n_ref=len(DEFAULT_REFS))
+
+
+def test_check_read_batch_catches_corruption(tmp_path):
+    recs = synth_records(50, seed=3)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    ds = ReadsStorage.make_default().read(str(src))
+    bad = ds.reads
+    bad.cigar_offsets[1] = bad.cigar_offsets[-1] + 7
+    with pytest.raises(AssertionError, match="cigar_offsets"):
+        check_read_batch(bad)
+
+
+def test_check_voffsets():
+    check_voffsets(np.array([1, 2, 3], dtype=np.uint64))
+    with pytest.raises(AssertionError, match="record 2"):
+        check_voffsets(np.array([1, 5, 5], dtype=np.uint64))
+
+
+def test_debug_env_gates_checks(tmp_path, monkeypatch):
+    monkeypatch.setenv("DISQ_TPU_DEBUG", "1")
+    recs = synth_records(200, seed=4)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    ds = ReadsStorage.make_default().read(str(src))   # runs checks inline
+    assert ds.count() == 200
