@@ -60,10 +60,16 @@ def inflate_blocks(
 
     Uses the threaded C++ batch inflater when built (blocks are
     independent raw-DEFLATE streams — embarrassingly parallel); falls
-    back to per-block host zlib.
+    back to per-block host zlib. Set ``DISQ_TPU_DEVICE_INFLATE=1`` to
+    route through the Pallas inflate kernel instead
+    (``disq_tpu.ops.inflate`` — the device path; CRC checked on host).
     """
     if not blocks:
         return b""
+    from disq_tpu.runtime.debug import env_flag
+
+    if env_flag("DISQ_TPU_DEVICE_INFLATE"):
+        return inflate_blocks_device(data, blocks, base, verify_crc=verify_crc)
     try:
         from disq_tpu.native import inflate_blocks_native
 
@@ -85,6 +91,35 @@ def inflate_blocks(
     parts = [
         inflate_block(data, b.pos - base, verify_crc=verify_crc) for b in blocks
     ]
+    return b"".join(parts)
+
+
+def inflate_blocks_device(
+    data: bytes, blocks: Sequence[BgzfBlock], base: int = 0,
+    verify_crc: bool = True,
+) -> bytes:
+    """Device path of ``inflate_blocks``: the Pallas raw-DEFLATE kernel
+    (one block per grid program) with ISIZE validated in-kernel and CRC
+    on host."""
+    from disq_tpu.ops.inflate import inflate_payloads
+
+    if not blocks:
+        return b""
+    payloads = []
+    for b in blocks:
+        off = b.pos - base
+        xlen = struct.unpack_from("<H", data, off + 10)[0]
+        payloads.append(
+            data[off + 12 + xlen: off + b.csize - BGZF_FOOTER_SIZE]
+        )
+    parts = inflate_payloads(payloads, usizes=[b.usize for b in blocks])
+    if verify_crc:
+        for i, (b, part) in enumerate(zip(blocks, parts)):
+            crc = struct.unpack_from(
+                "<I", data, b.pos - base + b.csize - BGZF_FOOTER_SIZE
+            )[0]
+            if zlib.crc32(part) != crc:
+                raise ValueError(f"BGZF CRC mismatch at block {i}")
     return b"".join(parts)
 
 

@@ -16,8 +16,13 @@ import os
 import numpy as np
 
 
+def env_flag(name: str) -> bool:
+    """Shared boolean env-var semantics: unset/""/0/false/off ⇒ False."""
+    return os.environ.get(name, "0").lower() not in ("", "0", "false", "off")
+
+
 def debug_enabled() -> bool:
-    return os.environ.get("DISQ_TPU_DEBUG", "0") not in ("", "0", "false")
+    return env_flag("DISQ_TPU_DEBUG")
 
 
 def _check_offsets(name: str, offsets: np.ndarray, n: int, data_len: int) -> None:

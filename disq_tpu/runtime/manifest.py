@@ -39,8 +39,13 @@ class StageManifest:
             "stages": {},
         }
         if os.path.exists(path):
-            with open(path, "r") as f:
-                stored = json.load(f)
+            try:
+                with open(path, "r") as f:
+                    stored = json.load(f)
+            except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+                # A damaged manifest must not block recovery — treat it
+                # exactly like an incompatible one: start fresh.
+                stored = {}
             if stored.get("version") != FORMAT_VERSION or (
                 params is not None and stored.get("params") != params
             ):

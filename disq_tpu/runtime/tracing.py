@@ -49,11 +49,11 @@ def start_trace(trace_dir: str) -> None:
 
 def stop_trace() -> None:
     global _trace_active
-    import jax
-
     with _lock:
         if not _trace_active:
             return
+        import jax
+
         jax.profiler.stop_trace()
         _trace_active = False
 
@@ -71,12 +71,14 @@ def trace_phase(name: str) -> Iterator[None]:
         annotation = contextlib.nullcontext()
 
     t0 = time.perf_counter()
-    with annotation:
-        yield
-    dt = time.perf_counter() - t0
-    with _lock:
-        _phases.append((name, dt))
-    logger.debug("phase %s: %.4fs", name, dt)
+    try:
+        with annotation:
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            _phases.append((name, dt))
+        logger.debug("phase %s: %.4fs", name, dt)
 
 
 def phase_report() -> Dict[str, Dict[str, float]]:

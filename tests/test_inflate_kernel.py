@@ -1,0 +1,167 @@
+"""Pallas raw-DEFLATE inflate kernel vs the zlib oracle (interpret mode
+on the CPU mesh; the same kernel lowers to Mosaic on TPU)."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from disq_tpu.ops.inflate import CMAX, UMAX, inflate_payloads
+
+
+def raw_deflate(data: bytes, level: int = 6) -> bytes:
+    c = zlib.compressobj(level, zlib.DEFLATED, -15)
+    return c.compress(data) + c.flush()
+
+
+def roundtrip(datas, level=6):
+    payloads = [raw_deflate(d, level) for d in datas]
+    out = inflate_payloads(
+        payloads, usizes=[len(d) for d in datas], interpret=True
+    )
+    for got, want in zip(out, datas):
+        assert got == want
+
+
+def test_simple_text():
+    roundtrip([b"hello hello hello world, here is a deflate stream"])
+
+
+def test_empty():
+    roundtrip([b""])
+
+
+def test_single_byte():
+    roundtrip([b"x"])
+
+
+def test_stored_blocks_level0():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    roundtrip([data], level=0)     # incompressible + level 0 → stored
+
+
+def test_random_bytes_all_levels():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+    for level in (1, 6, 9):
+        roundtrip([data], level=level)
+
+
+def test_overlapping_matches():
+    # dist=1 run-length copies and short periodic patterns
+    roundtrip([b"a" * 10000, b"ab" * 5000, b"abc" * 3000])
+
+
+def test_compressible_structured():
+    rng = np.random.default_rng(2)
+    # low-entropy bytes → dynamic Huffman with skewed code lengths
+    data = rng.choice([65, 67, 71, 84], size=20000,
+                      p=[0.7, 0.1, 0.1, 0.1]).astype(np.uint8).tobytes()
+    for level in (1, 6, 9):
+        roundtrip([data], level=level)
+
+
+def test_full_64k_block():
+    rng = np.random.default_rng(3)
+    data = rng.choice([0, 1, 2, 255], size=UMAX).astype(np.uint8).tobytes()
+    comp = raw_deflate(data, 9)
+    assert len(comp) <= CMAX - 8
+    roundtrip([data], level=9)
+
+
+def test_batch_of_mixed_blocks():
+    rng = np.random.default_rng(4)
+    datas = [
+        b"",
+        b"q",
+        b"the quick brown fox " * 200,
+        rng.integers(0, 256, 10000, dtype=np.uint8).tobytes(),
+        bytes(range(256)) * 100,
+        b"\x00" * 30000,
+    ]
+    roundtrip(datas)
+
+
+def test_matches_far_distances():
+    # force matches with distances spanning the full 32 KiB window
+    rng = np.random.default_rng(5)
+    chunk = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+    data = chunk + rng.integers(0, 256, 30000, dtype=np.uint8).tobytes() + chunk
+    roundtrip([data], level=9)
+
+
+def test_real_bgzf_payload():
+    """Payloads exactly as the BAM source stages them."""
+    from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+    from disq_tpu.bgzf.guesser import find_block_table
+    from disq_tpu.fsw import MemoryFileSystemWrapper
+
+    data = make_bam_bytes(DEFAULT_REFS, synth_records(800, seed=7))
+    fs = MemoryFileSystemWrapper()
+    fs.write_all("mem://in.bam", data)
+    blocks = find_block_table(fs, "mem://in.bam")
+    payloads, usizes, expect = [], [], []
+    for blk in blocks:
+        if blk.usize == 0:
+            continue
+        raw = data[blk.pos: blk.pos + blk.csize]
+        xlen = int.from_bytes(raw[10:12], "little")
+        payloads.append(raw[12 + xlen: blk.csize - 8])
+        usizes.append(blk.usize)
+        expect.append(zlib.decompress(payloads[-1], -15))
+    got = inflate_payloads(payloads, usizes=usizes, interpret=True)
+    assert got == expect
+
+
+def test_corrupt_stream_reports_error():
+    payload = bytearray(raw_deflate(b"hello world, this will be corrupted " * 50))
+    payload[len(payload) // 2] ^= 0xFF
+    with pytest.raises(ValueError, match="device inflate failed"):
+        inflate_payloads([bytes(payload)], interpret=True)
+
+
+def test_truncated_stream_reports_error():
+    payload = raw_deflate(b"some data that will be truncated " * 100)
+    with pytest.raises(ValueError, match="device inflate failed"):
+        inflate_payloads([payload[: len(payload) // 2]], interpret=True)
+
+
+def test_isize_mismatch_detected():
+    payload = raw_deflate(b"abcdefgh")
+    with pytest.raises(ValueError, match="error 8"):
+        inflate_payloads([payload], usizes=[9999], interpret=True)
+
+
+def test_end_to_end_bam_read_via_device_inflate(tmp_path, monkeypatch):
+    """Full ReadsStorage.read with DISQ_TPU_DEVICE_INFLATE=1: the Pallas
+    kernel decodes every BGZF block on the read path."""
+    from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+    from disq_tpu.api import ReadsStorage
+
+    recs = synth_records(1500, seed=8)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    host = ReadsStorage.make_default().read(str(src))
+    monkeypatch.setenv("DISQ_TPU_DEVICE_INFLATE", "1")
+    dev = ReadsStorage.make_default().read(str(src))
+    assert dev.count() == host.count() == 1500
+    np.testing.assert_array_equal(dev.reads.pos, host.reads.pos)
+    np.testing.assert_array_equal(dev.reads.seqs, host.reads.seqs)
+    np.testing.assert_array_equal(dev.reads.quals, host.reads.quals)
+
+
+def test_device_inflate_crc_mismatch(tmp_path, monkeypatch):
+    from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+    from disq_tpu.bgzf.codec import inflate_blocks_device
+    from disq_tpu.bgzf.guesser import find_block_table
+    from disq_tpu.fsw import MemoryFileSystemWrapper
+
+    data = bytearray(make_bam_bytes(DEFAULT_REFS, synth_records(100, seed=9)))
+    fs = MemoryFileSystemWrapper()
+    fs.write_all("mem://x.bam", bytes(data))
+    blocks = [b for b in find_block_table(fs, "mem://x.bam") if b.usize > 0]
+    # corrupt a CRC byte of the first block
+    data[blocks[0].pos + blocks[0].csize - 8] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        inflate_blocks_device(bytes(data), blocks)
