@@ -183,9 +183,8 @@ class BamSource:
             )
             if not window_blocks:
                 return None
-            window = np.frombuffer(
-                inflate_blocks(data, window_blocks, base=block_start),
-                dtype=np.uint8,
+            window = inflate_blocks(
+                data, window_blocks, base=block_start, as_array=True
             )
             u = g.find_first_record(window)
             at_eof = window_blocks[-1].end >= file_length
@@ -256,12 +255,12 @@ class BamSource:
             sum(b.csize for b in owned),
             sum(b.usize for b in owned),
         )
-        blob = inflate_blocks(data, blocks, base=lo_block)
+        blob = inflate_blocks(data, blocks, base=lo_block, as_array=True)
         if hi_u > 0:
             acc_before_hi = sum(b.usize for b in blocks if b.pos < hi_block)
             end_u = acc_before_hi + hi_u
         else:
             end_u = len(blob)
-        record_bytes = np.frombuffer(blob, dtype=np.uint8)[lo_u:end_u]
+        record_bytes = blob[lo_u:end_u]
         offsets = scan_record_offsets(record_bytes)
         return decode_records(record_bytes, offsets, n_ref=header.n_ref), stats

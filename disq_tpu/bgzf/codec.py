@@ -52,8 +52,9 @@ def inflate_block(data: bytes, offset: int = 0, verify_crc: bool = True) -> byte
 
 
 def inflate_blocks(
-    data: bytes, blocks: Sequence[BgzfBlock], base: int = 0, verify_crc: bool = True
-) -> bytes:
+    data: bytes, blocks: Sequence[BgzfBlock], base: int = 0,
+    verify_crc: bool = True, as_array: bool = False,
+):
     """Inflate many blocks from a staged buffer. ``base`` is the file
     offset at which ``data[0]`` sits, so ``BgzfBlock.pos`` (absolute)
     indexes correctly into the buffer.
@@ -64,16 +65,17 @@ def inflate_blocks(
     route through the Pallas inflate kernel instead
     (``disq_tpu.ops.inflate`` — the device path; CRC checked on host).
     """
+    import numpy as np
+
     if not blocks:
-        return b""
+        return np.empty(0, dtype=np.uint8) if as_array else b""
     from disq_tpu.runtime.debug import env_flag
 
     if env_flag("DISQ_TPU_DEVICE_INFLATE"):
-        return inflate_blocks_device(data, blocks, base, verify_crc=verify_crc)
+        out = inflate_blocks_device(data, blocks, base, verify_crc=verify_crc)
+        return np.frombuffer(out, dtype=np.uint8) if as_array else out
     try:
         from disq_tpu.native import inflate_blocks_native
-
-        import numpy as np
 
         arr = np.frombuffer(data, dtype=np.uint8)
         off = np.array([b.pos - base for b in blocks], dtype=np.int64)
@@ -84,14 +86,16 @@ def inflate_blocks(
             arr[off + 11].astype(np.int32) << 8
         )
         return inflate_blocks_native(
-            arr, off, 12 + xlen, csize, usize, verify_crc=verify_crc
+            arr, off, 12 + xlen, csize, usize, verify_crc=verify_crc,
+            as_array=as_array,
         )
     except ImportError:
         pass
     parts = [
         inflate_block(data, b.pos - base, verify_crc=verify_crc) for b in blocks
     ]
-    return b"".join(parts)
+    out = b"".join(parts)
+    return np.frombuffer(out, dtype=np.uint8) if as_array else out
 
 
 def inflate_blocks_device(

@@ -122,38 +122,72 @@ def _walk_blocks(
     return _walk_blocks_collect(fs, path, first, end, file_length)[0]
 
 
+def _walk_buffer(buf: bytes, stop: int) -> tuple[list, int]:
+    """Walk complete blocks in ``buf`` whose start is ``< stop``.
+    Returns ([(rel_pos, csize, usize), …], consumed_bytes). Native C walk
+    when built; pure-Python header parse otherwise."""
+    try:
+        from disq_tpu.native import walk_bgzf_blocks_native
+
+        rel, cs, us = walk_bgzf_blocks_native(buf, stop)
+        if len(rel) == 0:
+            return [], 0
+        return (
+            list(zip(rel.tolist(), cs.tolist(), us.tolist())),
+            int(rel[-1]) + int(cs[-1]),
+        )
+    except ImportError:
+        pass
+    entries = []
+    p = 0
+    while p < stop:
+        # Break (not raise) on any header that isn't complete in the
+        # buffer — including an XLEN that runs past the end — so the
+        # caller re-reads from p; malformed headers with all bytes
+        # present still raise via parse_block_header.
+        if p + 12 > len(buf):
+            break
+        xlen = struct.unpack_from("<H", buf, p + 10)[0]
+        if p + 12 + xlen > len(buf):
+            break
+        total = parse_block_header(buf, p)
+        if p + total > len(buf):
+            break
+        isize = struct.unpack_from("<I", buf, p + total - 4)[0]
+        entries.append((p, total, isize))
+        p += total
+    return entries, p
+
+
 def _walk_blocks_collect(
     fs: FileSystemWrapper, path: str, first: int, end: int, file_length: int,
     chunk: int = 8 * 1024 * 1024,
 ) -> tuple[List[BgzfBlock], bytes]:
     """As ``_walk_blocks``, but also returns the staged compressed bytes
     covering exactly ``[first, last_block.end)`` — so callers that go on
-    to inflate don't re-read the range from storage."""
+    to inflate don't re-read the range from storage.
+
+    Each iteration stages a chunk from the current block start, walks all
+    complete blocks in it in one native call, and re-reads from the first
+    straddling block — so the staged parts concatenate contiguously."""
     blocks: List[BgzfBlock] = []
-    data = bytearray()  # contiguous coverage from `first`
+    parts: List[bytes] = []
     pos = first
-    buf = b""
-    buf_start = 0
     while pos < end and pos < file_length:
-        if not (buf_start <= pos and pos + BGZF_MAX_BLOCK_SIZE <= buf_start + len(buf)):
-            want = min(chunk, file_length - pos)
-            buf = fs.read_range(path, pos, want)
-            buf_start = pos
-            # Extend contiguous coverage; successive reads start at the
-            # current block start, which lies within already-covered span.
-            covered_to = first + len(data)
-            if buf_start + len(buf) > covered_to:
-                data += buf[covered_to - buf_start:]
-        rel = pos - buf_start
-        total = parse_block_header(buf, rel)
-        if rel + total > len(buf):
+        want = min(max(chunk, 2 * BGZF_MAX_BLOCK_SIZE), file_length - pos)
+        buf = fs.read_range(path, pos, want)
+        entries, consumed = _walk_buffer(buf, min(end - pos, len(buf)))
+        if not entries:
+            # A whole-buffer read with no complete block: the final block
+            # runs past EOF (or the header itself is malformed).
             raise ValueError(f"truncated BGZF block at {pos} in {path}")
-        isize = struct.unpack_from("<I", buf, rel + total - 4)[0]
-        blocks.append(BgzfBlock(pos=pos, csize=total, usize=isize))
-        pos += total
+        for rel, cs, us in entries:
+            blocks.append(BgzfBlock(pos=pos + rel, csize=cs, usize=us))
+        parts.append(buf[:consumed])
+        pos += consumed
     if not blocks:
         return [], b""
-    return blocks, bytes(data[: blocks[-1].end - first])
+    return blocks, b"".join(parts)
 
 
 def find_block_table(

@@ -32,13 +32,23 @@ def _build() -> None:
     # Unique temp name: concurrent first-use builds in sibling processes
     # must not interleave output into the same file; os.replace is atomic.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        _SRC, "-o", tmp, "-lz", "-pthread",
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    # Prefer the libdeflate inflate/CRC fast path; retry zlib-only when
+    # libdeflate headers/libs are absent on this host.
+    variants = [
+        base + ["-DDISQ_HAVE_LIBDEFLATE", "-ldeflate", "-lz", "-pthread"],
+        base + ["-lz", "-pthread"],
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, _SO)
+        err = None
+        for cmd in variants:
+            try:
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp, _SO)
+                return
+            except subprocess.CalledProcessError as e:
+                err = e
+        raise err
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
@@ -74,6 +84,11 @@ def _load() -> ctypes.CDLL:
         lib.disq_scan_bam_offsets.argtypes = [u8p, ctypes.c_int64, i64p, ctypes.c_int64]
         lib.disq_count_bam_records.restype = ctypes.c_int64
         lib.disq_count_bam_records.argtypes = [u8p, ctypes.c_int64]
+        lib.disq_bgzf_walk.restype = ctypes.c_int64
+        lib.disq_bgzf_walk.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, i64p, i32p, i32p,
+            ctypes.c_int64,
+        ]
         lib.disq_bgzf_inflate_many.restype = ctypes.c_int64
         lib.disq_bgzf_inflate_many.argtypes = [
             u8p, i64p, i32p, i32p, i32p, ctypes.c_int64, u8p, i64p,
@@ -136,11 +151,37 @@ def scan_bam_offsets_native(buf, base: int = 0) -> np.ndarray:
     return out
 
 
+def walk_bgzf_blocks_native(
+    buf, stop: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Walk BGZF headers in ``buf`` (which starts at a block start),
+    collecting every complete block whose start is ``< stop``. Returns
+    (rel_pos i64, csize i32, usize i32) arrays; stops cleanly at a block
+    straddling the buffer end."""
+    lib = _load()
+    arr = _as_u8(buf)
+    max_out = len(arr) // 28 + 1  # minimal BGZF block is 28 bytes
+    rel = np.empty(max_out, dtype=np.int64)
+    cs = np.empty(max_out, dtype=np.int32)
+    us = np.empty(max_out, dtype=np.int32)
+    n = lib.disq_bgzf_walk(
+        _ptr(arr, ctypes.c_uint8), len(arr), stop,
+        _ptr(rel, ctypes.c_int64), _ptr(cs, ctypes.c_int32),
+        _ptr(us, ctypes.c_int32), max_out,
+    )
+    if n < 0:
+        raise ValueError(f"malformed BGZF block header at offset {-(n + 1)}")
+    return rel[:n], cs[:n], us[:n]
+
+
 def inflate_blocks_native(
     data, block_off: np.ndarray, hdr_len: np.ndarray, csize: np.ndarray,
     usize: np.ndarray, verify_crc: bool = True, nthreads: int | None = None,
-) -> bytes:
-    """Batched BGZF inflate; returns the concatenated payload bytes."""
+    as_array: bool = False,
+):
+    """Batched BGZF inflate; returns the concatenated payload as bytes,
+    or zero-copy as a uint8 array when ``as_array`` (hot read path —
+    skips a full payload memcpy)."""
     lib = _load()
     arr = _as_u8(data)
     block_off = np.ascontiguousarray(block_off, dtype=np.int64)
@@ -157,11 +198,13 @@ def inflate_blocks_native(
         _ptr(out, ctypes.c_uint8), _ptr(out_off, ctypes.c_int64),
         1 if verify_crc else 0, nthreads or DEFAULT_THREADS,
     )
+    if rc == len(usize) + 1:
+        raise MemoryError("libdeflate decompressor allocation failed")
     if rc > 0:
         raise ValueError(f"BGZF inflate failed at block {rc - 1}")
     if rc < 0:
         raise ValueError(f"BGZF CRC mismatch at block {-rc - 1}")
-    return out.tobytes()
+    return out if as_array else out.tobytes()
 
 
 def decode_records_native(buf, offsets: np.ndarray):
