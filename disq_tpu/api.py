@@ -48,6 +48,9 @@ class VariantsFormatWriteOption(WriteOption, enum.Enum):
     VCF = "vcf"
     VCF_GZ = "vcf.gz"
     VCF_BGZ = "vcf.bgz"
+    # Extension beyond reference parity: upstream disq has no BCF
+    # (SURVEY.md §2.1 note); BASELINE.json's matrix mentions BCF read.
+    BCF = "bcf"
 
 
 class FileCardinalityWriteOption(WriteOption, enum.Enum):
@@ -182,7 +185,7 @@ def _infer_cardinality(path: str) -> FileCardinalityWriteOption:
     """Extension ⇒ SINGLE merged file; otherwise a directory of complete
     per-shard files (ref: FileCardinalityWriteOption default inference)."""
     lowered = path.lower()
-    for ext in (".bam", ".cram", ".sam", ".vcf", ".vcf.gz", ".vcf.bgz"):
+    for ext in (".bam", ".cram", ".sam", ".vcf", ".vcf.gz", ".vcf.bgz", ".bcf"):
         if lowered.endswith(ext):
             return FileCardinalityWriteOption.SINGLE
     return FileCardinalityWriteOption.MULTIPLE
@@ -280,6 +283,10 @@ class VariantsStorage:
     def read(
         self, path: str, intervals: Optional[Sequence[Interval]] = None
     ) -> VariantsDataset:
+        if path.lower().endswith(".bcf"):
+            from disq_tpu.vcf.bcf import BcfSource
+
+            return BcfSource(self).get_variants(path, intervals)
         from disq_tpu.vcf.source import VcfSource
 
         return VcfSource(self).get_variants(path, intervals)
@@ -289,7 +296,18 @@ class VariantsStorage:
     ) -> None:
         from disq_tpu.vcf.sink import VcfSink, VcfSinkMultiple
 
+        fmt_opt = _opt(options, VariantsFormatWriteOption, None)
         cardinality = _opt(options, FileCardinalityWriteOption, _infer_cardinality(path))
+        if fmt_opt is VariantsFormatWriteOption.BCF or (
+            fmt_opt is None and path.lower().endswith(".bcf")
+        ):
+            from disq_tpu.vcf.bcf import BcfSink, BcfSinkMultiple
+
+            if cardinality is FileCardinalityWriteOption.SINGLE:
+                BcfSink(self).save(dataset, path, options)
+            else:
+                BcfSinkMultiple(self).save(dataset, path, options)
+            return
         if cardinality is FileCardinalityWriteOption.SINGLE:
             VcfSink(self).save(dataset, path, options)
         else:

@@ -1,0 +1,262 @@
+"""BCF 2.2 codec tests.
+
+Oracles: (a) a hand-constructed binary record assembled field-by-field
+from the VCFv4.3 §6 layout (independent of the encoder under test),
+(b) text → BCF → text round-trips through the storage API, (c) the
+container is valid multi-member gzip (external conformance via the
+stdlib gzip module).
+"""
+
+import gzip
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from disq_tpu.api import VariantsFormatWriteOption
+from disq_tpu import VariantsStorage
+from disq_tpu.api import Interval
+from disq_tpu.vcf.bcf import (
+    BCF_MAGIC,
+    BcfDictionaries,
+    build_bcf_header_block,
+    decode_bcf_records,
+    encode_bcf_records,
+    read_bcf_header_block,
+)
+from disq_tpu.vcf.columnar import parse_vcf_lines
+from disq_tpu.vcf.header import VcfHeader
+
+HDR = (
+    "##fileformat=VCFv4.3\n"
+    '##contig=<ID=chr1,length=1000000>\n'
+    '##contig=<ID=chr2,length=500000>\n'
+    '##FILTER=<ID=q10,Description="low qual">\n'
+    '##INFO=<ID=DP,Number=1,Type=Integer,Description="depth">\n'
+    '##INFO=<ID=AF,Number=A,Type=Float,Description="freq">\n'
+    '##INFO=<ID=DB,Number=0,Type=Flag,Description="dbsnp">\n'
+    '##INFO=<ID=CSQ,Number=.,Type=String,Description="csq">\n'
+    '##FORMAT=<ID=GT,Number=1,Type=String,Description="genotype">\n'
+    '##FORMAT=<ID=DP,Number=1,Type=Integer,Description="depth">\n'
+    '##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="qual">\n'
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts1\ts2\n"
+)
+
+LINES = [
+    "chr1\t100\trs1\tA\tT\t29.5\tPASS\tDP=14;AF=0.5;DB\tGT:DP:GQ\t0|1:12:99\t1/1:.:7",
+    "chr1\t200\t.\tAC\tA,ACT\t.\tq10\tDP=7;CSQ=x|y\tGT:DP\t0/1:3\t./.:.",
+    "chr2\t300\t.\tG\t.\t10\t.\t.\tGT\t0/0\t1|1",
+]
+
+
+def _header():
+    return VcfHeader.from_text(HDR)
+
+
+def _batch(lines=LINES):
+    return parse_vcf_lines([l.encode() for l in lines], _header().contig_names)
+
+
+class TestDictionaries:
+    def test_pass_is_zero_and_order(self):
+        d = BcfDictionaries(_header())
+        assert d.strings[0] == "PASS"
+        assert d.string_index["q10"] == 1
+        assert d.string_index["DP"] == 2  # first DP declaration wins the slot
+        assert d.contig_index == {"chr1": 0, "chr2": 1}
+
+    def test_idx_respected(self):
+        h = VcfHeader.from_text(
+            "##fileformat=VCFv4.3\n"
+            '##contig=<ID=cX,IDX=3>\n'
+            '##FILTER=<ID=PASS,Description="ok",IDX=0>\n'
+            '##INFO=<ID=DP,Number=1,Type=Integer,Description="d",IDX=7>\n'
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        d = BcfDictionaries(h)
+        assert d.strings[7] == "DP"
+        assert d.contig(3) == "cX"
+
+
+class TestRoundTrip:
+    def test_text_binary_text(self):
+        header, batch = _header(), _batch()
+        blob = encode_bcf_records(batch, header)
+        back = decode_bcf_records(b"\x00" * 4 + blob, header, 4)
+        assert back.count == len(LINES)
+        for i, want in enumerate(LINES):
+            assert back.line(i) == want
+        np.testing.assert_array_equal(back.chrom, batch.chrom)
+        np.testing.assert_array_equal(back.pos, batch.pos)
+        np.testing.assert_array_equal(back.end, batch.end)
+
+    def test_header_block(self):
+        h, off = read_bcf_header_block(build_bcf_header_block(_header()))
+        assert h.contig_names == ("chr1", "chr2")
+        assert h.samples == ("s1", "s2")
+
+    def test_no_samples(self):
+        hdr = VcfHeader.from_text(
+            "##fileformat=VCFv4.3\n"
+            '##contig=<ID=c1,length=100>\n'
+            '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        lines = ["c1\t5\t.\tA\tC\t1\tPASS\tDP=2", "c1\t7\t.\tT\t.\t.\t.\t."]
+        batch = parse_vcf_lines([l.encode() for l in lines], hdr.contig_names)
+        blob = encode_bcf_records(batch, hdr)
+        back = decode_bcf_records(blob, hdr, 0)
+        assert [back.line(i) for i in range(2)] == lines
+
+
+class TestHandConstructedRecord:
+    """Decode a record assembled by hand from the spec layout."""
+
+    def test_decode_known_bytes(self):
+        hdr = VcfHeader.from_text(
+            "##fileformat=VCFv4.3\n"
+            '##contig=<ID=chr9,length=1000>\n'
+            '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        shared = bytearray()
+        shared += struct.pack("<iii", 0, 41, 1)        # CHROM=chr9 POS0=41 rlen=1
+        shared += struct.pack("<f", 50.0)              # QUAL
+        shared += struct.pack("<II", (2 << 16) | 1, 0)  # 2 alleles, 1 info, 0 fmt
+        shared += bytes([0x27]) + b"id"                # ID "id" (len2<<4|char)
+        shared += bytes([0x17]) + b"C"                 # REF "C"
+        shared += bytes([0x17]) + b"G"                 # ALT "G"
+        shared += bytes([0x11, 0x00])                  # FILTER [0] = PASS
+        shared += bytes([0x11, 0x01])                  # key idx 1 = DP
+        shared += bytes([0x11, 0x2A])                  # DP=42 (int8)
+        rec = struct.pack("<II", len(shared), 0) + bytes(shared)
+        batch = decode_bcf_records(rec, hdr, 0)
+        assert batch.count == 1
+        assert batch.line(0) == "chr9\t42\tid\tC\tG\t50\tPASS\tDP=42"
+        assert int(batch.pos[0]) == 42 and int(batch.end[0]) == 42
+
+
+class TestStorageApi:
+    def test_write_read_bcf(self, tmp_path):
+        header, batch = _header(), _batch()
+        from disq_tpu.api import VariantsDataset
+
+        ds = VariantsDataset(header=header, variants=batch)
+        path = str(tmp_path / "x.bcf")
+        storage = VariantsStorage.make_default()
+        storage.write(ds, path)
+        # container is valid multi-member gzip, starts with BCF magic
+        with open(path, "rb") as f:
+            raw = f.read()
+        assert gzip.decompress(raw)[:5] == BCF_MAGIC
+        back = storage.read(path)
+        assert back.count() == len(LINES)
+        assert [back.variants.line(i) for i in range(len(LINES))] == LINES
+        assert back.header.samples == ("s1", "s2")
+
+    def test_format_write_option_dispatch(self, tmp_path):
+        header, batch = _header(), _batch()
+        from disq_tpu.api import VariantsDataset
+
+        ds = VariantsDataset(header=header, variants=batch)
+        from disq_tpu.api import FileCardinalityWriteOption
+
+        path = str(tmp_path / "weird.ext")
+        VariantsStorage.make_default().write(
+            ds, path, VariantsFormatWriteOption.BCF,
+            FileCardinalityWriteOption.SINGLE,
+        )
+        with open(path, "rb") as f:
+            assert gzip.decompress(f.read())[:5] == BCF_MAGIC
+
+    def test_interval_filter(self, tmp_path):
+        header, batch = _header(), _batch()
+        from disq_tpu.api import VariantsDataset
+
+        path = str(tmp_path / "x.bcf")
+        storage = VariantsStorage.make_default()
+        storage.write(VariantsDataset(header=header, variants=batch), path)
+        got = storage.read(path, intervals=[Interval("chr1", 150, 250)])
+        assert got.count() == 1
+        assert got.variants.line(0) == LINES[1]
+
+    def test_undeclared_contig_auto_added(self, tmp_path):
+        # The sink appends ##contig lines for contigs present only in the
+        # data (htsjdk-lenient), so the round trip succeeds.
+        hdr = VcfHeader.from_text(
+            "##fileformat=VCFv4.3\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        batch = parse_vcf_lines([b"chrZ\t1\t.\tA\tC\t1\tPASS\t."], ())
+        from disq_tpu.api import VariantsDataset
+
+        p = str(tmp_path / "x.bcf")
+        storage = VariantsStorage.make_default()
+        storage.write(VariantsDataset(header=hdr, variants=batch), p)
+        back = storage.read(p)
+        assert back.count() == 1
+        assert back.variants.line(0) == "chrZ\t1\t.\tA\tC\t1\tPASS\t."
+        assert "##contig=<ID=chrZ>" in back.header.text
+
+    def test_multiple_cardinality(self, tmp_path):
+        from disq_tpu.api import FileCardinalityWriteOption, VariantsDataset
+
+        header, batch = _header(), _batch()
+        d = str(tmp_path / "parts")
+        storage = VariantsStorage.make_default()
+        storage.write(
+            VariantsDataset(header=header, variants=batch), d,
+            VariantsFormatWriteOption.BCF, FileCardinalityWriteOption.MULTIPLE,
+        )
+        parts = sorted(os.listdir(d))
+        assert parts and all(p.endswith(".bcf") for p in parts)
+        got = []
+        for p in parts:
+            ds = storage.read(os.path.join(d, p))
+            got += [ds.variants.line(i) for i in range(ds.count())]
+        assert got == LINES
+
+    def test_gt_wide_alleles_promote_to_int16(self):
+        # allele index 63 → (63+1)<<1 = 128 doesn't fit int8
+        alt = ",".join("A" * (k % 5 + 2) for k in range(70))
+        hdr = VcfHeader.from_text(
+            "##fileformat=VCFv4.3\n"
+            '##contig=<ID=c1,length=100>\n'
+            '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts1\n"
+        )
+        line = f"c1\t5\t.\tG\t{alt}\t1\tPASS\t.\tGT\t0/70"
+        batch = parse_vcf_lines([line.encode()], hdr.contig_names)
+        blob = encode_bcf_records(batch, hdr)
+        back = decode_bcf_records(blob, hdr, 0)
+        assert back.line(0) == line
+
+    def test_inf_nan_floats_survive(self):
+        hdr = VcfHeader.from_text(
+            "##fileformat=VCFv4.3\n"
+            '##contig=<ID=c1,length=100>\n'
+            '##INFO=<ID=AF,Number=1,Type=Float,Description="f">\n'
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        )
+        line = "c1\t5\t.\tA\tC\tinf\tPASS\tAF=-inf"
+        batch = parse_vcf_lines([line.encode()], hdr.contig_names)
+        blob = encode_bcf_records(batch, hdr)
+        back = decode_bcf_records(blob, hdr, 0)
+        assert back.line(0) == line
+
+    def test_truncated_header_block_raises(self):
+        import struct as _s
+
+        bad = b"BCF\x02\x02" + _s.pack("<I", 10_000) + b"short\x00"
+        with pytest.raises(ValueError, match="truncated BCF header"):
+            read_bcf_header_block(bad)
+
+    def test_not_bcf_magic(self, tmp_path):
+        from disq_tpu.bgzf.codec import compress_to_bgzf
+
+        p = str(tmp_path / "fake.bcf")
+        with open(p, "wb") as f:
+            f.write(compress_to_bgzf(b"not a bcf at all"))
+        with pytest.raises(ValueError, match="magic|BCF"):
+            VariantsStorage.make_default().read(p)
