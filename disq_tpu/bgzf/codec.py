@@ -136,6 +136,14 @@ def deflate_blob(blob: bytes) -> tuple[bytes, "np.ndarray"]:
 
     if len(blob) == 0:
         return b"", np.zeros(0, dtype=np.int64)
+    from disq_tpu.runtime.debug import env_flag
+
+    if env_flag("DISQ_TPU_DEVICE_DEFLATE"):
+        # Device dynamic-Huffman encoder (disq_tpu.ops.deflate): valid
+        # BGZF but NOT byte-identical to the canonical zlib pin.
+        from disq_tpu.ops.deflate import deflate_blob_device
+
+        return deflate_blob_device(blob)
     pay_off = np.arange(0, len(blob) + BGZF_MAX_PAYLOAD, BGZF_MAX_PAYLOAD, dtype=np.int64)
     pay_off[-1] = len(blob)
     try:

@@ -1,0 +1,139 @@
+"""Device DEFLATE encoder tests (disq_tpu/ops/deflate.py).
+
+Oracle: stdlib zlib must inflate every stream back to the exact
+payload — the encoder and its verifier share no code.
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from disq_tpu.bgzf.codec import decompress_bgzf
+from disq_tpu.ops.deflate import (
+    BLOCK_PAYLOAD,
+    build_dynamic_header,
+    canonical_codes,
+    deflate_blob_device,
+    limited_huffman_lengths,
+)
+
+
+class TestHuffman:
+    def test_kraft_equality_random_alphabets(self):
+        rng = np.random.default_rng(1)
+        for _ in range(25):
+            k = int(rng.integers(2, 257))
+            freq = np.zeros(257, np.int64)
+            idx = rng.choice(257, k, replace=False)
+            freq[idx] = rng.integers(1, 100_000, k)
+            lens = limited_huffman_lengths(freq, 15)
+            assert lens.max() <= 15
+            assert (lens[freq > 0] > 0).all() and (lens[freq == 0] == 0).all()
+            kraft = float(np.sum(2.0 ** -lens[lens > 0].astype(float)))
+            assert abs(kraft - 1.0) < 1e-12
+
+    def test_limit_binds_on_skewed_freqs(self):
+        # Fibonacci-ish frequencies force unlimited Huffman past 15 bits.
+        freq = np.zeros(40, np.int64)
+        a, b = 1, 1
+        for i in range(40):
+            freq[i] = a
+            a, b = b, a + b
+        lens = limited_huffman_lengths(freq, 15)
+        assert lens.max() == 15
+        kraft = float(np.sum(2.0 ** -lens[lens > 0].astype(float)))
+        assert abs(kraft - 1.0) < 1e-12
+
+    def test_single_symbol(self):
+        freq = np.zeros(10, np.int64)
+        freq[3] = 7
+        lens = limited_huffman_lengths(freq, 15)
+        assert lens[3] == 1 and lens.sum() == 1
+
+    def test_canonical_assignment(self):
+        # RFC 1951 §3.2.2 worked example: lengths (3,3,3,3,3,2,4,4)
+        lens = np.array([3, 3, 3, 3, 3, 2, 4, 4])
+        codes = canonical_codes(lens)
+        assert list(codes) == [2, 3, 4, 5, 6, 0, 14, 15]
+
+
+class TestDeviceDeflate:
+    def _roundtrip(self, payload: bytes):
+        comp, sizes = deflate_blob_device(payload)
+        assert decompress_bgzf(comp) == payload
+        assert int(sizes.sum()) == len(comp)
+        return comp
+
+    def test_bam_like_payload(self):
+        rng = np.random.default_rng(2)
+        payload = (
+            rng.integers(0, 42, 150_000, dtype=np.uint8).tobytes()
+            + rng.integers(0, 16, 150_000, dtype=np.uint8).tobytes()
+        )
+        comp = self._roundtrip(payload)
+        assert len(comp) < len(payload)  # entropy coding helps here
+
+    def test_incompressible_falls_back_to_stored(self):
+        rng = np.random.default_rng(3)
+        payload = rng.integers(0, 256, 130_000, dtype=np.uint8).tobytes()
+        comp = self._roundtrip(payload)
+        # stored blocks: bounded expansion (headers + footers only)
+        assert len(comp) < len(payload) + 64 * ((len(payload) // BLOCK_PAYLOAD) + 1)
+
+    @pytest.mark.parametrize("n", [1, 2, 255, BLOCK_PAYLOAD, BLOCK_PAYLOAD + 1])
+    def test_edge_sizes(self, n):
+        rng = np.random.default_rng(n)
+        self._roundtrip(rng.integers(0, 5, n, dtype=np.uint8).tobytes())
+
+    def test_empty(self):
+        comp, sizes = deflate_blob_device(b"")
+        assert comp == b"" and len(sizes) == 0
+
+    def test_repetitive_payload(self):
+        self._roundtrip(b"ACGT" * 40_000)
+
+    def test_every_stream_is_plain_zlib_decodable(self):
+        # Per-block: strip BGZF framing, inflate with raw zlib only.
+        import struct
+
+        payload = b"qualityqualityquality" * 3000
+        comp, sizes = deflate_blob_device(payload)
+        pos = 0
+        out = b""
+        for sz in sizes:
+            xlen = struct.unpack_from("<H", comp, pos + 10)[0]
+            stream = comp[pos + 12 + xlen: pos + int(sz) - 8]
+            out += zlib.decompress(stream, -15)
+            pos += int(sz)
+        assert out == payload
+
+    def test_env_flag_routes_write_path(self, tmp_path, monkeypatch):
+        from disq_tpu.bgzf.codec import deflate_blob
+
+        monkeypatch.setenv("DISQ_TPU_DEVICE_DEFLATE", "1")
+        payload = b"the device write path" * 1000
+        comp, sizes = deflate_blob(payload)
+        assert decompress_bgzf(comp) == payload
+
+
+class TestHeader:
+    def test_header_bits_decode_as_valid_block_prefix(self):
+        # A header plus a lone EOB must be a complete empty DEFLATE block.
+        freq = np.zeros(257, np.int64)
+        freq[65] = 10
+        freq[256] = 1
+        lit_lens = limited_huffman_lengths(freq, 15)
+        acc, nbits = build_dynamic_header(lit_lens, np.array([1], np.int32))
+        codes = canonical_codes(lit_lens)
+        eob_len = int(lit_lens[256])
+        eob = int(codes[256])
+        rev = 0
+        for _ in range(eob_len):
+            rev = (rev << 1) | (eob & 1)
+            eob >>= 1
+        acc |= rev << nbits
+        total = nbits + eob_len
+        stream = acc.to_bytes((total + 7) // 8, "little")
+        assert zlib.decompress(stream, -15) == b""
