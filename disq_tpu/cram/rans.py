@@ -37,8 +37,10 @@ def _normalize_freqs(counts: np.ndarray, total: int = TOTFREQ) -> np.ndarray:
     out = np.floor(f).astype(np.int64)
     out[(counts > 0) & (out == 0)] = 1
     # Adjust to hit the exact total: add/remove from the largest symbols.
+    # Stable sort (ties by symbol index) so the native C++ codec can
+    # reproduce the same table byte-for-byte.
     diff = total - out.sum()
-    order = np.argsort(-out)
+    order = np.argsort(-out, kind="stable")
     i = 0
     while diff != 0:
         s = order[i % len(order)]
@@ -110,6 +112,12 @@ def _read_freq_table0(data, off: int) -> Tuple[np.ndarray, int]:
 # -- order-0 encode ---------------------------------------------------------
 
 def rans_encode_order0(raw: bytes) -> bytes:
+    try:
+        from disq_tpu.native import rans_encode0_native
+
+        return rans_encode0_native(raw)
+    except ImportError:
+        pass
     data = np.frombuffer(raw, dtype=np.uint8)
     n = len(data)
     if n == 0:
@@ -147,6 +155,21 @@ def rans_decode(data: bytes) -> bytes:
     order, comp_size, raw_size = struct.unpack_from("<BII", data, 0)
     if raw_size == 0:
         return b""
+    if order == 0:
+        from disq_tpu.runtime.debug import env_flag
+
+        if env_flag("DISQ_TPU_DEVICE_RANS"):
+            # Pallas kernel path (order-0): disq_tpu.ops.rans.
+            from disq_tpu.ops.rans import rans0_decode_device
+
+            return rans0_decode_device([data])[0]
+    if order in (0, 1):
+        try:
+            from disq_tpu.native import rans_decode_native
+
+            return rans_decode_native(data)
+        except ImportError:
+            pass
     body = memoryview(data)[9:9 + comp_size]
     if order == 0:
         return _decode0(body, raw_size)

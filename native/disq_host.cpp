@@ -14,6 +14,7 @@
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 disq_host.cpp -o libdisq_host.so -lz -pthread
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -412,6 +413,297 @@ int64_t disq_bam_encode(uint8_t* out, const int64_t* rec_off, int64_t n,
     std::memcpy(p, tags + tag_off[i], tl);
   }
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// rANS 4x8 (CRAM 3.0 §13) — native port of disq_tpu/cram/rans.py.
+// Order-0 encode/decode + order-1 decode; stream layout matches
+// htslib's rANS_static (order u8, comp_size u32, raw_size u32, freq
+// table, 4 interleaved u32 states, renorm bytes).
+
+static const int kTfShift = 12;
+static const int kTotFreq = 1 << kTfShift;  // 4096
+static const uint32_t kRansLow = 1u << 23;
+
+// Mirror of _normalize_freqs: floor-scale, clamp present symbols to >=1,
+// then fix the total by walking symbols in stable descending-frequency
+// order (ties by symbol index) — byte-identical tables to the Python pin.
+static void rans_normalize(const int64_t* counts, int64_t* out) {
+  int64_t n = 0;
+  for (int s = 0; s < 256; s++) n += counts[s];
+  if (n == 0) {
+    for (int s = 0; s < 256; s++) out[s] = 0;
+    return;
+  }
+  int64_t sum = 0;
+  for (int s = 0; s < 256; s++) {
+    double f = (double)counts[s] * kTotFreq / (double)n;
+    out[s] = (int64_t)f;  // floor for non-negative
+    if (counts[s] > 0 && out[s] == 0) out[s] = 1;
+    sum += out[s];
+  }
+  int idx[256];
+  for (int s = 0; s < 256; s++) idx[s] = s;
+  std::stable_sort(idx, idx + 256,
+                   [&](int a, int b) { return out[a] > out[b]; });
+  int64_t diff = kTotFreq - sum;
+  int64_t i = 0;
+  while (diff != 0) {
+    int s = idx[i % 256];
+    if (out[s] > 0 || diff > 0) {
+      int64_t step = diff > 0 ? 1 : -1;
+      if (out[s] + step >= 1 || counts[s] == 0) {
+        out[s] += step;
+        diff -= step;
+      }
+    }
+    i++;
+  }
+}
+
+static int64_t rans_write_table0(const int64_t* freqs, uint8_t* out) {
+  int syms[256];
+  int ns = 0;
+  for (int s = 0; s < 256; s++)
+    if (freqs[s]) syms[ns++] = s;
+  int64_t p = 0;
+  int rle = 0;
+  for (int k = 0; k < ns; k++) {
+    int s = syms[k];
+    if (rle > 0) {
+      rle--;
+    } else {
+      out[p++] = (uint8_t)s;
+      if (k > 0 && s == syms[k - 1] + 1) {
+        int run = 0;
+        while (k + run + 1 < ns && syms[k + run + 1] == s + run + 1) run++;
+        out[p++] = (uint8_t)run;
+        rle = run;
+      }
+    }
+    int64_t f = freqs[s];
+    if (f < 128) {
+      out[p++] = (uint8_t)f;
+    } else {
+      out[p++] = (uint8_t)(0x80 | (f >> 8));
+      out[p++] = (uint8_t)(f & 0xFF);
+    }
+  }
+  out[p++] = 0;
+  return p;
+}
+
+static int64_t rans_read_table0(const uint8_t* d, int64_t len, int64_t off,
+                                int64_t* freqs) {
+  for (int s = 0; s < 256; s++) freqs[s] = 0;
+  if (off >= len) return -1;
+  int rle = 0;
+  int sym = d[off++];
+  int last;
+  for (;;) {
+    if (off >= len) return -1;
+    int64_t f = d[off++];
+    if (f >= 128) {
+      if (off >= len) return -1;
+      f = ((f & 0x7F) << 8) | d[off++];
+    }
+    if (sym > 255) return -1;
+    freqs[sym] = f;
+    if (rle > 0) {
+      rle--;
+      last = sym;
+      sym = sym + 1;
+      (void)last;
+      continue;
+    }
+    last = sym;
+    if (off >= len) return -1;
+    int nxt = d[off++];
+    if (nxt == 0) break;
+    if (nxt == last + 1) {
+      if (off >= len) return -1;
+      rle = d[off++];
+    }
+    sym = nxt;
+  }
+  return off;
+}
+
+extern "C" {
+
+// Order-0 encode. Returns total stream length (9-byte header + body),
+// or -1 when out_cap is too small. raw may be empty.
+int64_t disq_rans_encode0(const uint8_t* raw, int64_t n, uint8_t* out,
+                          int64_t out_cap) {
+  if (n == 0) {
+    if (out_cap < 9) return -1;
+    out[0] = 0;
+    std::memset(out + 1, 0, 8);
+    return 9;
+  }
+  int64_t counts[256] = {0};
+  for (int64_t i = 0; i < n; i++) counts[raw[i]]++;
+  int64_t freqs[256];
+  rans_normalize(counts, freqs);
+  int64_t cum[257];
+  cum[0] = 0;
+  for (int s = 0; s < 256; s++) cum[s + 1] = cum[s] + freqs[s];
+  if (out_cap < 9 + 771 + 16 + (n * 3) / 2 + 64) return -1;
+  uint8_t* body = out + 9;
+  int64_t p = rans_write_table0(freqs, body);
+  // Encode in reverse; renorm bytes are emitted reversed then flipped.
+  std::vector<uint8_t> rev;
+  rev.reserve((size_t)n / 2);
+  uint32_t states[4] = {kRansLow, kRansLow, kRansLow, kRansLow};
+  for (int64_t i = n - 1; i >= 0; i--) {
+    int s = raw[i];
+    int j = (int)(i & 3);
+    uint32_t x = states[j];
+    uint32_t f = (uint32_t)freqs[s];
+    uint32_t x_max = ((kRansLow >> kTfShift) << 8) * f;
+    while (x >= x_max) {
+      rev.push_back((uint8_t)(x & 0xFF));
+      x >>= 8;
+    }
+    states[j] = ((x / f) << kTfShift) + (x % f) + (uint32_t)cum[s];
+  }
+  for (int j = 0; j < 4; j++) {
+    std::memcpy(body + p, &states[j], 4);
+    p += 4;
+  }
+  for (int64_t k = (int64_t)rev.size() - 1; k >= 0; k--) body[p++] = rev[k];
+  out[0] = 0;
+  uint32_t comp = (uint32_t)p, rs = (uint32_t)n;
+  std::memcpy(out + 1, &comp, 4);
+  std::memcpy(out + 5, &rs, 4);
+  return 9 + p;
+}
+
+// Decode (order 0 or 1). data = full stream incl. 9-byte header; out
+// must hold raw_size bytes (as announced in the header — the caller
+// reads it first). Returns 0, or a negative error code.
+int64_t disq_rans_decode(const uint8_t* data, int64_t len, uint8_t* out,
+                         int64_t out_len) {
+  if (len < 9) return -2;
+  int order = data[0];
+  uint32_t comp_size, raw_size;
+  std::memcpy(&comp_size, data + 1, 4);
+  std::memcpy(&raw_size, data + 5, 4);
+  if (raw_size == 0) return 0;
+  if ((int64_t)raw_size != out_len) return -3;
+  const uint8_t* body = data + 9;
+  int64_t blen = comp_size;
+  if (9 + blen > len) return -4;
+
+  if (order == 0) {
+    int64_t freqs[256];
+    int64_t off = rans_read_table0(body, blen, 0, freqs);
+    if (off < 0) return -5;
+    int64_t cum[257];
+    cum[0] = 0;
+    for (int s = 0; s < 256; s++) cum[s + 1] = cum[s] + freqs[s];
+    if (cum[256] != kTotFreq) return -6;
+    uint8_t lookup[kTotFreq];
+    for (int s = 0; s < 256; s++)
+      for (int64_t k = cum[s]; k < cum[s + 1]; k++) lookup[k] = (uint8_t)s;
+    if (off + 16 > blen) return -4;
+    uint32_t states[4];
+    for (int j = 0; j < 4; j++) {
+      std::memcpy(&states[j], body + off, 4);
+      off += 4;
+    }
+    for (int64_t i = 0; i < (int64_t)raw_size; i++) {
+      int j = (int)(i & 3);
+      uint32_t x = states[j];
+      uint32_t m = x & (kTotFreq - 1);
+      int s = lookup[m];
+      out[i] = (uint8_t)s;
+      x = (uint32_t)freqs[s] * (x >> kTfShift) + m - (uint32_t)cum[s];
+      while (x < kRansLow && off < blen) x = (x << 8) | body[off++];
+      states[j] = x;
+    }
+    return 0;
+  }
+
+  if (order == 1) {
+    // Context tables, RLE over contexts like the symbol list.
+    static_assert(sizeof(int64_t) == 8, "");
+    std::vector<int64_t> freqs(256 * 256, 0);
+    std::vector<int64_t> cum(256 * 257, 0);
+    std::vector<uint8_t> lookups(256 * kTotFreq);
+    std::vector<bool> built(256, false);
+    int64_t off = 0;
+    int rle_i = 0;
+    if (blen < 1) return -4;
+    int i = body[off++];
+    int last_i;
+    for (;;) {
+      off = rans_read_table0(body, blen, off, &freqs[(int64_t)i * 256]);
+      if (off < 0) return -5;
+      if (rle_i > 0) {
+        rle_i--;
+        last_i = i;
+        i++;
+        if (i > 255) return -5;
+        continue;
+      }
+      last_i = i;
+      if (off >= blen) return -4;
+      int nxt = body[off++];
+      if (nxt == 0) break;
+      if (nxt == last_i + 1) {
+        if (off >= blen) return -4;
+        rle_i = body[off++];
+      }
+      i = nxt;
+    }
+    for (int c = 0; c < 256; c++) {
+      int64_t* cm = &cum[(int64_t)c * 257];
+      const int64_t* fr = &freqs[(int64_t)c * 256];
+      cm[0] = 0;
+      for (int s = 0; s < 256; s++) cm[s + 1] = cm[s] + fr[s];
+    }
+    if (off + 16 > blen) return -4;
+    uint32_t states[4];
+    for (int j = 0; j < 4; j++) {
+      std::memcpy(&states[j], body + off, 4);
+      off += 4;
+    }
+    int64_t q = (int64_t)raw_size / 4;
+    int64_t pos[4] = {0, q, 2 * q, 3 * q};
+    int64_t ends[4] = {q, 2 * q, 3 * q, (int64_t)raw_size};
+    int ctx[4] = {0, 0, 0, 0};
+    int64_t remaining = raw_size;
+    while (remaining) {
+      for (int j = 0; j < 4; j++) {
+        if (pos[j] >= ends[j]) continue;
+        int c = ctx[j];
+        if (!built[c]) {
+          const int64_t* cm = &cum[(int64_t)c * 257];
+          if (cm[256] != kTotFreq) return -6;
+          uint8_t* lk = &lookups[(int64_t)c * kTotFreq];
+          for (int s = 0; s < 256; s++)
+            for (int64_t k = cm[s]; k < cm[s + 1]; k++) lk[k] = (uint8_t)s;
+          built[c] = true;
+        }
+        uint32_t x = states[j];
+        uint32_t m = x & (kTotFreq - 1);
+        int s = lookups[(int64_t)c * kTotFreq + m];
+        out[pos[j]] = (uint8_t)s;
+        x = (uint32_t)freqs[(int64_t)c * 256 + s] * (x >> kTfShift) + m -
+            (uint32_t)cum[(int64_t)c * 257 + s];
+        while (x < kRansLow && off < blen) x = (x << 8) | body[off++];
+        states[j] = x;
+        ctx[j] = s;
+        pos[j]++;
+        remaining--;
+      }
+    }
+    return 0;
+  }
+  return -7;
 }
 
 }  // extern "C"
