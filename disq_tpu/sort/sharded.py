@@ -155,6 +155,191 @@ def sharded_sort_step(
     )(hi, lo, rows, s_hi, s_lo)
 
 
+def _sort_stage_payload(
+    hi, lo, rows, vals, s_hi, s_lo, *, axis: str, n_shards: int, cap: int
+):
+    """As ``_sort_stage``, but the exchange also carries a fixed-width
+    payload matrix — whole records ride the ICI all_to_all, not just
+    keys. vals: (1, per_shard, W) u32 blocks."""
+    hi, lo, rows = hi.reshape(-1), lo.reshape(-1), rows.reshape(-1)
+    vals = vals.reshape(hi.shape[0], -1)
+    w = vals.shape[1]
+    valid = ~((hi == SENT32) & (lo == SENT32))
+    dest = _dest_shard(hi, lo, s_hi, s_lo)
+    dest = jnp.where(valid, dest, n_shards)
+    order = jnp.argsort(dest, stable=True)
+    dest_g = dest[order]
+    hi_g, lo_g, rows_g, vals_g = hi[order], lo[order], rows[order], vals[order]
+    valid_g = valid[order]
+    counts = jnp.bincount(
+        jnp.where(valid_g, dest_g, 0),
+        weights=valid_g.astype(jnp.int32),
+        length=n_shards,
+    ).astype(jnp.int32)
+    m = hi.shape[0]
+    group_start = jnp.searchsorted(dest_g, dest_g, side="left")
+    within = jnp.arange(m) - group_start
+    send_hi = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
+    send_lo = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
+    send_rows = jnp.zeros((n_shards, cap), dtype=rows.dtype)
+    send_vals = jnp.zeros((n_shards, cap, w), dtype=vals.dtype)
+    send_hi = send_hi.at[dest_g, within].set(hi_g, mode="drop")
+    send_lo = send_lo.at[dest_g, within].set(lo_g, mode="drop")
+    send_rows = send_rows.at[dest_g, within].set(rows_g, mode="drop")
+    send_vals = send_vals.at[dest_g, within].set(vals_g, mode="drop")
+    ok = jnp.all(lax.psum((counts > cap).astype(jnp.int32), axis) == 0)
+    recv_hi = lax.all_to_all(send_hi, axis, split_axis=0, concat_axis=0)
+    recv_lo = lax.all_to_all(send_lo, axis, split_axis=0, concat_axis=0)
+    recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0)
+    recv_vals = lax.all_to_all(send_vals, axis, split_axis=0, concat_axis=0)
+    fh, fl, fr = recv_hi.reshape(-1), recv_lo.reshape(-1), recv_rows.reshape(-1)
+    fv = recv_vals.reshape(-1, w)
+    final = jnp.lexsort((fl, fh))
+    out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
+    out_vals = fv[final]
+    n_valid = jnp.sum(~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)
+    return (
+        out_hi[None], out_lo[None], out_rows[None], out_vals[None],
+        n_valid[None], ok[None],
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "capacity_factor"))
+def sharded_sort_payload_step(
+    hi, lo, rows, vals, s_hi, s_lo, *,
+    mesh: Mesh, axis: str = "shards", capacity_factor: float = 2.0,
+):
+    """One sort exchange moving keys AND a (n_shards, per_shard, W)
+    u32 payload (the packed fixed record columns)."""
+    n_shards = mesh.shape[axis]
+    per_shard = hi.shape[1]
+    cap = min(int(per_shard * capacity_factor / n_shards) + 1, per_shard)
+    body = functools.partial(
+        _sort_stage_payload, axis=axis, n_shards=n_shards, cap=cap
+    )
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None), P(axis, None), P(axis, None), P(axis, None, None),
+            P(None), P(None),
+        ),
+        out_specs=(
+            P(axis, None), P(axis, None), P(axis, None), P(axis, None, None),
+            P(axis), P(axis),
+        ),
+    )(hi, lo, rows, vals, s_hi, s_lo)
+
+
+# Packed fixed-column layout for the record exchange (all u32):
+_PAYLOAD_COLS = (
+    "refid", "pos", "flag_mapq", "bin", "next_refid", "next_pos", "tlen"
+)
+
+
+def sharded_sort_read_batch(batch, mesh: Optional[Mesh] = None,
+                            axis: str = "shards",
+                            capacity_factor: float = 2.0):
+    """Coordinate-sort a ``ReadBatch`` with the record exchange running
+    on the mesh: fixed columns travel through the all_to_all packed as
+    u32; ragged columns (name/cigar/seq/qual/tags) are reordered
+    host-side by the returned row permutation (one segment gather),
+    mirroring how the write path consumes the batch.
+
+    Returns (sorted_batch, permutation).
+    """
+    from disq_tpu.bam.columnar import ReadBatch  # local: avoid cycle
+    from disq_tpu.sort.coordinate import coordinate_keys
+
+    mesh = mesh or make_mesh()
+    n_shards = mesh.shape[axis]
+    n = batch.count
+    if n == 0:
+        return batch, np.zeros(0, dtype=np.int64)
+    keys = coordinate_keys(np.asarray(batch.refid), np.asarray(batch.pos))
+    per_shard = -(-n // n_shards)
+    padded = per_shard * n_shards
+    keys_p = np.full(padded, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=np.uint64)
+    keys_p[:n] = keys
+    hi_p, lo_p = split_u64_keys(keys_p)
+    rows_p = np.zeros(padded, dtype=np.uint32)
+    rows_p[:n] = np.arange(n, dtype=np.uint32)
+    vals_p = np.zeros((padded, len(_PAYLOAD_COLS)), dtype=np.uint32)
+    vals_p[:n, 0] = np.asarray(batch.refid).view(np.uint32)
+    vals_p[:n, 1] = np.asarray(batch.pos).view(np.uint32)
+    vals_p[:n, 2] = (
+        np.asarray(batch.flag).astype(np.uint32)
+        | (np.asarray(batch.mapq).astype(np.uint32) << 16)
+    )
+    vals_p[:n, 3] = np.asarray(batch.bin).astype(np.uint32)
+    vals_p[:n, 4] = np.asarray(batch.next_refid).view(np.uint32)
+    vals_p[:n, 5] = np.asarray(batch.next_pos).view(np.uint32)
+    vals_p[:n, 6] = np.asarray(batch.tlen).view(np.uint32)
+    splitters = sample_splitters(keys, n_shards)
+    s_hi, s_lo = split_u64_keys(splitters)
+    shard2d = NamedSharding(mesh, P(axis, None))
+    shard3d = NamedSharding(mesh, P(axis, None, None))
+    repl = NamedSharding(mesh, P(None))
+    args = (
+        jax.device_put(hi_p.reshape(n_shards, per_shard), shard2d),
+        jax.device_put(lo_p.reshape(n_shards, per_shard), shard2d),
+        jax.device_put(rows_p.reshape(n_shards, per_shard), shard2d),
+        jax.device_put(
+            vals_p.reshape(n_shards, per_shard, -1), shard3d
+        ),
+        jax.device_put(s_hi, repl),
+        jax.device_put(s_lo, repl),
+    )
+    for _ in range(3):
+        oh, ol, orows, ovals, counts, ok = sharded_sort_payload_step(
+            *args, mesh=mesh, axis=axis, capacity_factor=capacity_factor
+        )
+        if bool(jnp.all(ok)):
+            cnt = np.asarray(counts)
+            vh = np.concatenate(
+                [np.asarray(ovals)[i, : cnt[i]] for i in range(n_shards)]
+            )
+            perm = np.concatenate(
+                [np.asarray(orows)[i, : cnt[i]] for i in range(n_shards)]
+            ).astype(np.int64)
+            from disq_tpu.bam.columnar import segment_gather
+
+            def rag(data, offs):
+                return segment_gather(data, offs, perm)
+
+            names, name_off = rag(batch.names, batch.name_offsets)
+            cigars, cigar_off = rag(batch.cigars, batch.cigar_offsets)
+            seqs, seq_off = rag(batch.seqs, batch.seq_offsets)
+            quals, _ = rag(batch.quals, batch.seq_offsets)
+            tags, tag_off = rag(batch.tags, batch.tag_offsets)
+            sorted_batch = ReadBatch(
+                refid=vh[:, 0].view(np.int32),
+                pos=vh[:, 1].view(np.int32),
+                mapq=(vh[:, 2] >> 16).astype(np.uint8),
+                bin=vh[:, 3].astype(np.uint16),
+                flag=(vh[:, 2] & 0xFFFF).astype(np.uint16),
+                next_refid=vh[:, 4].view(np.int32),
+                next_pos=vh[:, 5].view(np.int32),
+                tlen=vh[:, 6].view(np.int32),
+                name_offsets=name_off, names=names,
+                cigar_offsets=cigar_off, cigars=cigars,
+                seq_offsets=seq_off, seqs=seqs, quals=quals,
+                tag_offsets=tag_off, tags=tags,
+            )
+            return sorted_batch, perm
+        capacity_factor *= 2.0
+    # Skew defeated the capacity retries: host fallback.
+    from disq_tpu.sort.coordinate import coordinate_sort_batch
+
+    order = np.argsort(keys, kind="stable")
+    return coordinate_sort_batch(batch, use_mesh=False), order
+
+
 def sharded_coordinate_sort(
     keys_np: np.ndarray,
     mesh: Optional[Mesh] = None,
