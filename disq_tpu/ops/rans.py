@@ -192,6 +192,13 @@ def rans0_decode_device(streams: List[bytes], interpret=None) -> List[bytes]:
         cum = np.zeros(257, dtype=np.int64)
         np.cumsum(freqs, out=cum[1:])
         states = np.frombuffer(body, dtype="<u4", count=4, offset=off)
+        # The kernel carries states as int32; a valid encoder never
+        # produces a state >= 2^31 (encode caps x below kRansLow<<8 ≈
+        # 2^31), so reject rather than wrap negative and decode garbage.
+        if int(states.max(initial=0)) >= 1 << 31:
+            raise ValueError(
+                f"stream {k}: corrupt rANS state word >= 2^31"
+            )
         renorm = body[off + 16:]
         lookup = np.repeat(np.arange(256, dtype=np.int32), freqs)
         metas.append((raw_size, renorm, states, freqs, cum[:256], lookup))

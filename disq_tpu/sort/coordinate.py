@@ -42,15 +42,16 @@ def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
     keys = coordinate_keys(batch.refid, batch.pos)
     order = None
     if use_mesh and batch.count > 0:
-        try:
-            import jax
+        # Deliberate: only "mesh has a single device" selects the host
+        # path. A real failure inside the sharded sort must propagate —
+        # swallowing it here would let a broken mesh path silently degrade
+        # to the host argsort and never fail a test.
+        import jax
 
-            if len(jax.devices()) > 1:
-                from disq_tpu.sort.sharded import sharded_coordinate_sort
+        if len(jax.devices()) > 1:
+            from disq_tpu.sort.sharded import sharded_coordinate_sort
 
-                _, order = sharded_coordinate_sort(keys)
-        except Exception:
-            order = None
+            _, order = sharded_coordinate_sort(keys)
     if order is None:
         order = np.argsort(keys, kind="stable")
     return batch.take(order)

@@ -51,17 +51,13 @@ class BcfDictionaries:
         self.format_type: Dict[str, str] = {}
         self.format_number: Dict[str, str] = {}
 
-        def add(name: str, idx: Optional[int]) -> None:
-            if name in index:
-                return
-            if idx is None:
-                idx = 0 if name == "PASS" else (max(strings) + 1 if strings else 0)
-                while idx in strings:
-                    idx += 1
-            strings[idx] = name
-            index[name] = idx
-
-        add("PASS", 0)
+        # Two-pass id assignment (htslib behavior for the spec-invalid
+        # but seen-in-the-wild headers that mix ``IDX=``-annotated and
+        # unannotated lines): explicit ``IDX=`` lines register first, then
+        # implicit lines take sequential indices in declaration order,
+        # skipping every explicitly claimed index — so a later explicit
+        # line can never collide with an earlier implicit assignment.
+        decls: List[Tuple[str, Optional[int]]] = []
         contigs: List[str] = []
         contig_idx: Dict[str, int] = {}
         for line in header.text.splitlines():
@@ -80,7 +76,7 @@ class BcfDictionaries:
                     contig_idx[name] = idx if idx is not None else len(contigs)
                     contigs.append(name)
                 continue
-            add(name, idx)
+            decls.append((name, idx))
             mtype = re.search(r"(?:^|,)Type=([A-Za-z]+)", body)
             mnum = re.search(r"(?:^|,)Number=([^,>]+)", body)
             if kind == "INFO":
@@ -93,6 +89,32 @@ class BcfDictionaries:
                     self.format_type[name] = mtype.group(1)
                 if mnum:
                     self.format_number[name] = mnum.group(1)
+        # PASS holds index 0 unless the header carries its own explicit
+        # ``##FILTER=<ID=PASS,...,IDX=N>`` line, which wins.
+        if not any(n == "PASS" and i is not None for n, i in decls):
+            decls.insert(0, ("PASS", 0))
+        # Pass 1: explicit IDX= claims. Two lines claiming one index is a
+        # broken dictionary — decoding through it would silently mislabel
+        # fields, so reject.
+        for name, idx in decls:
+            if idx is None or name in index:
+                continue
+            if idx in strings:
+                raise ValueError(
+                    f"BCF header assigns IDX={idx} to both "
+                    f"{strings[idx]!r} and {name!r}"
+                )
+            strings[idx] = name
+            index[name] = idx
+        # Pass 2: implicit lines, sequential in declaration order.
+        next_implicit = 0
+        for name, idx in decls:
+            if idx is not None or name in index:
+                continue
+            while next_implicit in strings:
+                next_implicit += 1
+            strings[next_implicit] = name
+            index[name] = next_implicit
         self.strings = strings          # idx -> name
         self.string_index = index       # name -> idx
         # Contig dictionary: position by IDX when given, else header order.
@@ -297,6 +319,11 @@ def _gt_to_text(vals: Sequence[int], t: int) -> str:
     for k, v in enumerate(vals):
         if v == _INT_EOV[t]:
             break
+        # The int MISSING sentinel inside a GT vector (written by some
+        # foreign encoders instead of the spec's encoded no-call 0)
+        # renders as '.', same as allele value 0.
+        if v == _INT_MISSING[t]:
+            v = 0
         allele = "." if (v >> 1) == 0 else str((v >> 1) - 1)
         if k == 0:
             parts.append(allele)

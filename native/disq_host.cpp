@@ -621,7 +621,12 @@ int64_t disq_rans_decode(const uint8_t* data, int64_t len, uint8_t* out,
       int s = lookup[m];
       out[i] = (uint8_t)s;
       x = (uint32_t)freqs[s] * (x >> kTfShift) + m - (uint32_t)cum[s];
-      while (x < kRansLow && off < blen) x = (x << 8) | body[off++];
+      // A valid stream always has the renorm byte it needs (final states
+      // land exactly at kRansLow); a deficit means the body is truncated.
+      while (x < kRansLow) {
+        if (off >= blen) return -8;
+        x = (x << 8) | body[off++];
+      }
       states[j] = x;
     }
     return 0;
@@ -694,7 +699,10 @@ int64_t disq_rans_decode(const uint8_t* data, int64_t len, uint8_t* out,
         out[pos[j]] = (uint8_t)s;
         x = (uint32_t)freqs[(int64_t)c * 256 + s] * (x >> kTfShift) + m -
             (uint32_t)cum[(int64_t)c * 257 + s];
-        while (x < kRansLow && off < blen) x = (x << 8) | body[off++];
+        while (x < kRansLow) {
+          if (off >= blen) return -8;
+          x = (x << 8) | body[off++];
+        }
         states[j] = x;
         ctx[j] = s;
         pos[j]++;
