@@ -1,0 +1,126 @@
+"""Round 2: dynamic_gather throughput curves + scalar loop variants."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bench_gather_axis0(R, iters=300):
+    """take_along_axis axis=0, data (R,128), idx (R,128) — same-column gather."""
+    def k(d_ref, idx_ref, o_ref):
+        d = d_ref[...]
+        mask = jnp.int32(R - 1)
+
+        def body(_, cur):
+            return jnp.take_along_axis(d, cur & mask, axis=0)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, idx_ref[...])
+
+    d = jnp.asarray(np.random.randint(0, R, (R, 128)), jnp.int32)
+    idx = jnp.asarray(np.random.randint(0, R, (R, 128)), jnp.int32)
+    f = jax.jit(lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((R, 128), jnp.int32))(a, b))
+    try:
+        f(d, idx).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"axis0 R={R}: FAIL {str(e).splitlines()[0][:120]}")
+        return
+    t0 = time.time()
+    for _ in range(10):
+        r = f(d, idx)
+    r.block_until_ready()
+    dt = (time.time() - t0) / 10 / iters
+    print(f"axis0 R={R:5d}: {dt*1e9:8.0f} ns/gather  "
+          f"({R*128/dt/1e9:7.2f} G idx-elem/s)")
+
+
+def bench_gather_axis1(R, C, iters=300):
+    """take_along_axis axis=1 — within-row cross-lane gather."""
+    def k(d_ref, idx_ref, o_ref):
+        d = d_ref[...]
+        mask = jnp.int32(C - 1)
+
+        def body(_, cur):
+            return jnp.take_along_axis(d, cur & mask, axis=1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, idx_ref[...])
+
+    d = jnp.asarray(np.random.randint(0, C, (R, C)), jnp.int32)
+    idx = jnp.asarray(np.random.randint(0, C, (R, C)), jnp.int32)
+    f = jax.jit(lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((R, C), jnp.int32))(a, b))
+    try:
+        f(d, idx).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"axis1 R={R},C={C}: FAIL {str(e).splitlines()[0][:120]}")
+        return
+    t0 = time.time()
+    for _ in range(10):
+        r = f(d, idx)
+    r.block_until_ready()
+    dt = (time.time() - t0) / 10 / iters
+    print(f"axis1 R={R:4d},C={C:4d}: {dt*1e9:8.0f} ns/gather  "
+          f"({R*C/dt/1e9:7.2f} G idx-elem/s)")
+
+
+def bench_scalar(body_kind, iters=1_000_000):
+    def k(o_ref, s):
+        s[0] = jnp.int32(1)
+        if body_kind == "arith":
+            def body(i, acc):
+                return acc * 5 + (i ^ acc) - (acc >> 3)
+        elif body_kind == "smem_static":
+            def body(i, acc):
+                s[3] = acc
+                return acc + s[3] + 1
+        elif body_kind == "smem_dyn_read":
+            def body(i, acc):
+                return acc + s[i & 255] + 1
+        o_ref[0, 0] = jax.lax.fori_loop(0, iters, body, jnp.int32(0))
+
+    f = jax.jit(lambda: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((256,), jnp.int32)],
+    )())
+    f().block_until_ready()
+    t0 = time.time()
+    r = f()
+    r.block_until_ready()
+    dt = time.time() - t0
+    print(f"scalar {body_kind:14s}: {dt*1e9/iters:6.1f} ns/iter")
+
+
+def bench_cumsum(axis, R=512):
+    def k(d_ref, o_ref):
+        def body(_, cur):
+            return jnp.cumsum(cur, axis=axis) & 1023
+        o_ref[...] = jax.lax.fori_loop(0, 100, body, d_ref[...])
+
+    d = jnp.asarray(np.random.randint(0, 3, (R, 128)), jnp.int32)
+    f = jax.jit(lambda a: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((R, 128), jnp.int32))(a))
+    try:
+        f(d).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"cumsum axis={axis} (R={R}): FAIL {str(e).splitlines()[0][:120]}")
+        return
+    t0 = time.time()
+    for _ in range(10):
+        r = f(d)
+    r.block_until_ready()
+    dt = (time.time() - t0) / 10 / 100
+    print(f"cumsum axis={axis} ({R},128): {dt*1e9:8.0f} ns/op")
+
+
+for R in (8, 32, 128, 512, 1024, 2048):
+    bench_gather_axis0(R)
+for (R, C) in ((8, 128), (64, 128), (512, 128), (8, 512)):
+    bench_gather_axis1(R, C)
+for kind in ("arith", "smem_static", "smem_dyn_read"):
+    bench_scalar(kind)
+bench_cumsum(0)
+bench_cumsum(1)
+print("done")

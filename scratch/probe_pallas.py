@@ -1,0 +1,267 @@
+"""Empirical probes of Mosaic/Pallas TPU capabilities for the inflate redesign.
+
+Run on the real chip. Each probe is independent; failures print and continue.
+"""
+import time
+import traceback
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def probe(name):
+    def deco(fn):
+        t0 = time.time()
+        try:
+            fn()
+            print(f"[OK]   {name}  ({time.time()-t0:.1f}s)")
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).split("\n")[0][:200]
+            print(f"[FAIL] {name}: {type(e).__name__}: {msg}  ({time.time()-t0:.1f}s)")
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------- A: per-lane
+# gather from a shared VMEM table via jnp.take / indexing
+@probe("A1 take: table (1024,) idx (8,128)")
+def a1():
+    def k(tab_ref, idx_ref, o_ref):
+        tab = tab_ref[...].reshape(-1)
+        o_ref[...] = jnp.take(tab, idx_ref[...], axis=0)
+
+    tab = jnp.arange(1024, dtype=jnp.int32).reshape(8, 128)
+    idx = jnp.asarray(np.random.randint(0, 1024, (8, 128)), jnp.int32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+    )(tab, idx)
+    exp = np.arange(1024)[np.asarray(idx)]
+    assert (np.asarray(out) == exp).all(), "wrong values"
+
+
+@probe("A2 take_along_axis axis0: data (512,128), idx (8,128)")
+def a2():
+    def k(d_ref, idx_ref, o_ref):
+        o_ref[...] = jnp.take_along_axis(d_ref[...], idx_ref[...], axis=0)
+
+    d = jnp.asarray(np.random.randint(0, 255, (512, 128)), jnp.int32)
+    idx = jnp.asarray(np.random.randint(0, 512, (8, 128)), jnp.int32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+    )(d, idx)
+    exp = np.take_along_axis(np.asarray(d), np.asarray(idx), axis=0)
+    assert (np.asarray(out) == exp).all(), "wrong values"
+
+
+@probe("A3 big take: table 32768 flat, idx (8,128)")
+def a3():
+    def k(tab_ref, idx_ref, o_ref):
+        tab = tab_ref[...].reshape(-1)
+        o_ref[...] = jnp.take(tab, idx_ref[...], axis=0)
+
+    tab = jnp.arange(32768, dtype=jnp.int32).reshape(256, 128)
+    idx = jnp.asarray(np.random.randint(0, 32768, (8, 128)), jnp.int32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+    )(tab, idx)
+    assert (np.asarray(out) == np.asarray(idx)).all(), "wrong values"
+
+
+# ------------------------------------------------- B: gather throughput
+@probe("B1 timing: 1000 chained takes of (8,128) from 32768-table")
+def b1():
+    def k(tab_ref, idx_ref, o_ref):
+        tab = tab_ref[...].reshape(-1)
+        idx = idx_ref[...]
+
+        def body(_, idx):
+            return jnp.take(tab, idx, axis=0)
+
+        o_ref[...] = jax.lax.fori_loop(0, 1000, body, idx)
+
+    tab = jnp.asarray(np.random.randint(0, 32768, (256, 128)), jnp.int32)
+    idx = jnp.asarray(np.random.randint(0, 32768, (8, 128)), jnp.int32)
+    f = jax.jit(lambda t, i: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(t, i))
+    f(tab, idx).block_until_ready()
+    t0 = time.time()
+    for _ in range(10):
+        r = f(tab, idx)
+    r.block_until_ready()
+    dt = (time.time() - t0) / 10
+    per_gather = dt / 1000
+    print(f"    1000 chained (8,128) takes: {dt*1e3:.2f} ms"
+          f" -> {per_gather*1e9:.0f} ns per 1024-lane gather"
+          f" -> {1024/per_gather/1e9:.2f} G elem/s")
+
+
+@probe("B2 timing: 1000 chained takes of (8,128) from 1024-table")
+def b2():
+    def k(tab_ref, idx_ref, o_ref):
+        tab = tab_ref[...].reshape(-1)
+        idx = idx_ref[...] & 1023
+
+        def body(_, idx):
+            return jnp.take(tab, idx, axis=0) & 1023
+
+        o_ref[...] = jax.lax.fori_loop(0, 1000, body, idx)
+
+    tab = jnp.asarray(np.random.randint(0, 32768, (8, 128)), jnp.int32)
+    idx = jnp.asarray(np.random.randint(0, 1024, (8, 128)), jnp.int32)
+    f = jax.jit(lambda t, i: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(t, i))
+    f(tab, idx).block_until_ready()
+    t0 = time.time()
+    for _ in range(10):
+        r = f(tab, idx)
+    r.block_until_ready()
+    dt = (time.time() - t0) / 10
+    per_gather = dt / 1000
+    print(f"    1000 chained (8,128) takes(1K tab): {dt*1e3:.2f} ms"
+          f" -> {per_gather*1e9:.0f} ns per 1024-lane gather")
+
+
+@probe("B3 timing: chained take_along_axis (64,128)->(8,128) x1000")
+def b3():
+    def k(d_ref, idx_ref, o_ref):
+        d = d_ref[...]
+
+        def body(_, idx):
+            return jnp.take_along_axis(d, idx & 63, axis=0)
+
+        o_ref[...] = jax.lax.fori_loop(0, 1000, body, idx_ref[...])
+
+    d = jnp.asarray(np.random.randint(0, 64, (64, 128)), jnp.int32)
+    idx = jnp.asarray(np.random.randint(0, 64, (8, 128)), jnp.int32)
+    f = jax.jit(lambda t, i: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(t, i))
+    f(d, idx).block_until_ready()
+    t0 = time.time()
+    for _ in range(10):
+        r = f(d, idx)
+    r.block_until_ready()
+    dt = (time.time() - t0) / 10
+    print(f"    1000 chained take_along_axis: {dt*1e3:.2f} ms"
+          f" -> {dt/1000*1e9:.0f} ns per (8,128)")
+
+
+# ------------------------------------------------- C: SMEM scratch limits
+@probe("C1 SMEM scratch 64KB (16384 int32)")
+def c1():
+    def k(o_ref, s):
+        s[0] = jnp.int32(7)
+        s[16383] = jnp.int32(9)
+        o_ref[0, 0] = s[0] + s[16383]
+
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((16384,), jnp.int32)],
+    )()
+    assert int(out[0, 0]) == 16
+
+
+@probe("C2 SMEM scratch 512KB (131072 int32)")
+def c2():
+    def k(o_ref, s):
+        s[0] = jnp.int32(7)
+        s[131071] = jnp.int32(9)
+        o_ref[0, 0] = s[0] + s[131071]
+
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((131072,), jnp.int32)],
+    )()
+    assert int(out[0, 0]) == 16
+
+
+# ------------------------------------------------- D: scalar loop speed
+@probe("D1 scalar while-loop 1M iters, SMEM rw per iter")
+def d1():
+    def k(o_ref, s):
+        s[0] = jnp.int32(0)
+
+        def body(i, acc):
+            s[i & 1023] = acc
+            return acc + s[(i ^ 5) & 1023] + 1
+
+        o_ref[0, 0] = jax.lax.fori_loop(0, 1_000_000, body, jnp.int32(0))
+
+    f = jax.jit(lambda: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((1024,), jnp.int32)],
+    )())
+    f().block_until_ready()
+    t0 = time.time()
+    r = f()
+    r.block_until_ready()
+    dt = time.time() - t0
+    print(f"    1M scalar iters (2 smem ops each): {dt*1e3:.1f} ms"
+          f" -> {dt*1e9/1e6:.1f} ns/iter")
+
+
+# ------------------------------------------------- E: DMA SMEM <-> VMEM
+@probe("E1 async_copy SMEM->VMEM")
+def e1():
+    def k(o_ref, s, sem):
+        def fill(i, c):
+            s[i] = i
+            return c
+        jax.lax.fori_loop(0, 1024, fill, 0)
+        cp = pltpu.make_async_copy(s, o_ref, sem)
+        cp.start()
+        cp.wait()
+
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1024,), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.SMEM((1024,), jnp.int32),
+                        pltpu.SemaphoreType.DMA],
+    )()
+    assert (np.asarray(out) == np.arange(1024)).all()
+
+
+# ------------------------------------------------- F: vector variable shifts
+@probe("F1 per-lane variable right_shift")
+def f1():
+    def k(x_ref, s_ref, o_ref):
+        o_ref[...] = jax.lax.shift_right_logical(x_ref[...], s_ref[...])
+
+    x = jnp.asarray(np.random.randint(0, 2**31 - 1, (8, 128)), jnp.int32)
+    s = jnp.asarray(np.random.randint(0, 31, (8, 128)), jnp.int32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(x, s)
+    exp = np.asarray(x) >> np.asarray(s)
+    assert (np.asarray(out) == exp).all()
+
+
+# ------------------------------------------------- G: scatter (per-lane store)
+@probe("G1 scatter via one-hot accumulate (64,128)")
+def g1():
+    def k(idx_ref, val_ref, o_ref):
+        rows = jax.lax.broadcasted_iota(jnp.int32, (64, 128), 0)
+        idx = idx_ref[...]  # (8,128) row targets, lane-local
+        acc = jnp.zeros((64, 128), jnp.int32)
+        for r in range(8):
+            tgt = idx[r:r+1, :]
+            v = val_ref[r:r+1, :]
+            acc = acc + jnp.where(rows == tgt, v, 0)
+        o_ref[...] = acc
+
+    idx = jnp.asarray(np.random.randint(0, 64, (8, 128)), jnp.int32)
+    val = jnp.asarray(np.random.randint(1, 100, (8, 128)), jnp.int32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((64, 128), jnp.int32))(idx, val)
+    exp = np.zeros((64, 128), np.int32)
+    for r in range(8):
+        for l in range(128):
+            exp[np.asarray(idx)[r, l], l] += np.asarray(val)[r, l]
+    assert (np.asarray(out) == exp).all()
+
+
+print("probes done")
