@@ -1,0 +1,50 @@
+"""Round 3, probe 10: is probe9 real? Scale-and-verify the one-hot gather.
+
+If doubling inner iterations doesn't double wall time, the measurement is
+broken. Also check the chained one-hot loop produces the numpy-exact result,
+so dead-code elimination can't fake it.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def make_onehot(R, iters):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (R, 128), 0)
+
+        def body(_, cur):
+            g = jnp.sum(jnp.where(rows == cur, d, 0), axis=0, keepdims=True)
+            return (g + 1) & (R - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    rng = np.random.default_rng(0)
+    d = np.asarray(rng.integers(0, R, (R, 128)), np.int32)
+    idx = np.asarray(rng.integers(0, R, (1, 128)), np.int32)
+    f = jax.jit(lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b))
+    return f, jnp.asarray(d), jnp.asarray(idx), d, idx
+
+
+for iters in (2000, 20000, 200000):
+    f, d, idx, dn, idxn = make_onehot(512, iters)
+    r = f(d, idx)
+    r.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        r = f(d, idx)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / 3
+    # numpy oracle
+    cur = idxn.copy()
+    for _ in range(iters):
+        cur = (dn[cur & 511, np.arange(128)] + 1) & 511
+    ok = (np.asarray(r) == cur).all()
+    print(f"onehot512 iters={iters:7d}: {dt*1e9/iters:8.2f} ns/op "
+          f"(call {dt*1e3:8.2f} ms) values {'OK' if ok else 'WRONG'}")
+print("probe10 done")

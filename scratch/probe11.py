@@ -1,0 +1,43 @@
+"""Round 3, probe 11: marginal one-hot cost via slope (varying inputs,
+many reps, min-of-reps to cut axon RPC noise)."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def measure(R, iters, reps=8):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (R, 128), 0)
+
+        def body(_, cur):
+            g = jnp.sum(jnp.where(rows == cur, d, 0), axis=0, keepdims=True)
+            return (g + 1) & (R - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    f = jax.jit(lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b))
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, R, (R, 128)), jnp.int32)
+    idxs = [jnp.asarray(rng.integers(0, R, (1, 128)), jnp.int32)
+            for _ in range(reps)]
+    f(d, idxs[0]).block_until_ready()
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        f(d, idxs[i]).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    times = np.array(times) * 1e3
+    return times
+
+
+for R in (512, 1024, 4096):
+    for iters in (50_000, 400_000):
+        t = measure(R, iters)
+        print(f"onehot{R:5d} iters={iters:7d}: min {t.min():7.2f} ms  "
+              f"med {np.median(t):7.2f} ms  -> min {t.min()*1e6/iters:7.1f} ns/op")
+print("probe11 done")

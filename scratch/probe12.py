@@ -1,0 +1,44 @@
+"""Round 3, probe 12: one-hot cost with REAL sync (np.asarray materializes;
+block_until_ready on axon does not block). Slope over iters removes the
+RPC floor."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def measure(R, iters, reps=6):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (R, 128), 0)
+
+        def body(_, cur):
+            g = jnp.sum(jnp.where(rows == cur, d, 0), axis=0, keepdims=True)
+            return (g + 1) & (R - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    f = jax.jit(lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b))
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, R, (R, 128)), jnp.int32)
+    idxs = [jnp.asarray(rng.integers(0, R, (1, 128)), jnp.int32)
+            for _ in range(reps)]
+    np.asarray(f(d, idxs[0]))
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(f(d, idxs[i]))
+        times.append(time.perf_counter() - t0)
+    return np.array(times) * 1e3
+
+
+for R in (512, 1024, 4096):
+    lo = measure(R, 20_000)
+    hi = measure(R, 200_000)
+    slope = (hi.min() - lo.min()) * 1e6 / 180_000
+    print(f"onehot{R:5d}: 20k {lo.min():7.2f} ms  200k {hi.min():7.2f} ms"
+          f"  -> slope {slope:7.1f} ns/op")
+print("probe12 done")

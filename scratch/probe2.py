@@ -67,7 +67,11 @@ def bench_gather_axis1(R, C, iters=300):
 
 def bench_scalar(body_kind, iters=1_000_000):
     def k(o_ref, s):
-        s[0] = jnp.int32(1)
+        def init(i, c):
+            s[i] = i
+            return c
+
+        jax.lax.fori_loop(0, 256, init, 0)
         if body_kind == "arith":
             def body(i, acc):
                 return acc * 5 + (i ^ acc) - (acc >> 3)
@@ -78,6 +82,8 @@ def bench_scalar(body_kind, iters=1_000_000):
         elif body_kind == "smem_dyn_read":
             def body(i, acc):
                 return acc + s[i & 255] + 1
+        else:
+            raise ValueError(body_kind)
         o_ref[0, 0] = jax.lax.fori_loop(0, iters, body, jnp.int32(0))
 
     f = jax.jit(lambda: pl.pallas_call(
@@ -87,9 +93,10 @@ def bench_scalar(body_kind, iters=1_000_000):
     )())
     f().block_until_ready()
     t0 = time.time()
-    r = f()
+    for _ in range(10):
+        r = f()
     r.block_until_ready()
-    dt = time.time() - t0
+    dt = (time.time() - t0) / 10
     print(f"scalar {body_kind:14s}: {dt*1e9/iters:6.1f} ns/iter")
 
 

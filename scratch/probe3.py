@@ -1,0 +1,223 @@
+"""Round 3: resolve the D1-vs-probe2 scalar-loop contradiction.
+
+D1 (store+read same SMEM buffer) measured 150 ns/iter; probe2's read-only
+loops printed 0.0 ns/iter. The inflate rewrite lives or dies on which one
+the real decode loop resembles, so: isolate dynamic SMEM stores, loads,
+and store->load aliasing at several distances, plus a composite loop shaped
+like one Huffman symbol decode (refill + table read + output store).
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def run(name, kernel, iters, scratches, reps=10):
+    f = jax.jit(lambda: pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=scratches,
+    )())
+    try:
+        f().block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:24s}: FAIL {str(e).splitlines()[0][:110]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f()
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:24s}: {dt*1e9/iters:8.2f} ns/iter   (total {dt*1e3:.2f} ms,"
+          f" result {int(r[0, 0])})")
+
+
+ITERS = 1_000_000
+S1K = [pltpu.SMEM((1024,), jnp.int32)]
+S2 = [pltpu.SMEM((1024,), jnp.int32), pltpu.SMEM((1024,), jnp.int32)]
+
+
+def init(s, n=1024):
+    def body(i, c):
+        s[i] = i & 255
+        return c
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def k_arith(o_ref, s):
+    init(s)
+
+    def body(i, acc):
+        return acc * 5 + (i ^ acc) - (acc >> 3)
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_read_only(o_ref, s):
+    init(s)
+
+    def body(i, acc):
+        return acc + s[i & 1023] + 1
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_read_dep(o_ref, s):
+    """Read address depends on previous read (pointer-chase)."""
+    init(s)
+
+    def body(i, acc):
+        return s[(acc + i) & 1023] + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_store_only(o_ref, s):
+    def body(i, acc):
+        s[i & 1023] = acc
+        return acc + i
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0)) + s[7]
+
+
+def k_store_read_diff(o_ref, s, t):
+    """Store to one buffer, read a different one (decode loop shape:
+    output stores never alias comp/table reads)."""
+    init(t)
+
+    def body(i, acc):
+        s[i & 1023] = acc
+        return acc + t[i & 1023]
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0)) + s[7]
+
+
+def k_store_read_same_far(o_ref, s):
+    init(s)
+
+    def body(i, acc):
+        s[i & 1023] = acc
+        return acc + s[(i + 512) & 1023]
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_store_read_same_near(o_ref, s):
+    """dist-1 match-copy shape: read the slot written last iteration."""
+    init(s)
+
+    def body(i, acc):
+        s[i & 1023] = acc
+        return acc + s[(i - 1) & 1023]
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_d1_replica(o_ref, s):
+    init(s)
+
+    def body(i, acc):
+        s[i & 1023] = acc
+        return acc + s[(i ^ 5) & 1023] + 1
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_while_read(o_ref, s):
+    """Same as read_only but lax.while_loop with data-dependent-looking
+    bound (decode loops are while_loops, not fori)."""
+    init(s)
+
+    def cond(st):
+        i, acc = st
+        return i < ITERS
+
+    def body(st):
+        i, acc = st
+        return i + 1, acc + s[i & 1023] + 1
+
+    _, acc = jax.lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = acc
+
+
+def k_symbol_shape(o_ref, comp, tab, out):
+    """One iteration ~ one literal decode: halfword refill from comp,
+    root-table read, entry unpack, consume, output store. 100k syms."""
+    init(comp)
+    init(tab)
+    nsym = 100_000
+
+    def body(st):
+        n, hpos, buf, nbits, op = st
+        # refill to >16 bits (usually one halfword)
+        def rcond(s2):
+            h, b, nb = s2
+            return nb <= 16
+
+        def rbody(s2):
+            h, b, nb = s2
+            w = comp[(h >> 1) & 1023]
+            half = jax.lax.shift_right_logical(w, (h & 1) * 16) & 0xFFFF
+            return h + 1, b | (half << nb), nb + 16
+
+        hpos, buf, nbits = jax.lax.while_loop(rcond, rbody, (hpos, buf, nbits))
+        e = tab[buf & 511]
+        bits = (e & 7) + 7
+        sym = jax.lax.shift_right_logical(e, 8) & 255
+        buf = jax.lax.shift_right_logical(buf, bits)
+        nbits = nbits - bits
+        out[op & 1023] = sym
+        return n + 1, hpos, buf, nbits, op + 1
+
+    def cond(st):
+        return st[0] < nsym
+
+    _, _, buf, _, op = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                     jnp.int32(0)))
+    o_ref[0, 0] = buf + op + out[3]
+
+
+def k_match_shape(o_ref, out):
+    """Match-copy inner loop: out[i] = out[i - dist], dist=64. 1M bytes."""
+    init(out, 4096)
+
+    def body(i, acc):
+        v = out[(i - 64) & 4095]
+        out[i & 4095] = v
+        return acc + v
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_match_shape_d1(o_ref, out):
+    """Match-copy with dist=1 (run-length), the worst aliasing case."""
+    init(out, 4096)
+
+    def body(i, acc):
+        v = out[(i - 1) & 4095]
+        out[i & 4095] = v
+        return acc + v
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+run("arith", k_arith, ITERS, S1K)
+run("read_only", k_read_only, ITERS, S1K)
+run("read_dep_chase", k_read_dep, ITERS, S1K)
+run("store_only", k_store_only, ITERS, S1K)
+run("store_read_diff", k_store_read_diff, ITERS, S2)
+run("store_read_same_far", k_store_read_same_far, ITERS, S1K)
+run("store_read_same_near", k_store_read_same_near, ITERS, S1K)
+run("d1_replica", k_d1_replica, ITERS, S1K)
+run("while_read", k_while_read, ITERS, S1K)
+run("symbol_shape_100k", k_symbol_shape, 100_000,
+    [pltpu.SMEM((1024,), jnp.int32)] * 3)
+run("match_copy_dist64", k_match_shape, ITERS,
+    [pltpu.SMEM((4096,), jnp.int32)])
+run("match_copy_dist1", k_match_shape_d1, ITERS,
+    [pltpu.SMEM((4096,), jnp.int32)])
+print("probe3 done")

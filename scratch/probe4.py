@@ -1,0 +1,176 @@
+"""Round 3, probe 4: does work-per-iteration amortize the ~17ns loop cost?
+
+probe3 showed every fori/while iteration with >=1 dynamic SMEM access costs
+~17-19ns regardless of access count. If 8 accesses per iteration still cost
+~17-25ns, the inflate kernel should unroll/interleave aggressively; if cost
+scales with the dependent-chain length, interleaving independent streams is
+the only lever. Also: find the real SMEM allocation ceiling.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def run(name, kernel, iters, scratches, reps=10):
+    f = jax.jit(lambda: pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=scratches,
+    )())
+    try:
+        f().block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:28s}: FAIL {str(e).splitlines()[0][:110]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f()
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:28s}: {dt*1e9/iters:8.2f} ns/iter  (total {dt*1e3:.2f} ms,"
+          f" result {int(r[0, 0])})")
+
+
+def init(s, n=1024):
+    def body(i, c):
+        s[i] = (i * 37 + 11) & 1023
+        return c
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+ITERS = 250_000
+S1K = [pltpu.SMEM((1024,), jnp.int32)]
+
+
+def k_read8_indep(o_ref, s):
+    """8 independent reads per iteration."""
+    init(s)
+
+    def body(i, acc):
+        t = jnp.int32(0)
+        for j in range(8):
+            t = t + s[(i * 8 + j * 131) & 1023]
+        return acc + t
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_read8_chain(o_ref, s):
+    """8 chained (address-dependent) reads per iteration."""
+    init(s)
+
+    def body(i, acc):
+        v = i & 1023
+        for j in range(8):
+            v = s[(v + j) & 1023]
+        return acc + v
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_mixed8(o_ref, s, t):
+    """4 reads + 4 stores, independent, per iteration."""
+    init(t)
+
+    def body(i, acc):
+        a = jnp.int32(0)
+        for j in range(4):
+            a = a + t[(i * 4 + j * 211) & 1023]
+            s[(i * 4 + j) & 1023] = a + j
+        return acc + a
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_chase2(o_ref, s):
+    """2 interleaved independent pointer chases."""
+    init(s)
+
+    def body(i, st):
+        a, b = st
+        return s[(a + i) & 1023], s[(b + i * 3) & 1023]
+
+    a, b = jax.lax.fori_loop(0, ITERS, body, (jnp.int32(0), jnp.int32(1)))
+    o_ref[0, 0] = a + b
+
+
+def k_chase4(o_ref, s):
+    """4 interleaved independent pointer chases."""
+    init(s)
+
+    def body(i, st):
+        a, b, c, d = st
+        return (s[(a + i) & 1023], s[(b + i * 3) & 1023],
+                s[(c + i * 5) & 1023], s[(d + i * 7) & 1023])
+
+    a, b, c, d = jax.lax.fori_loop(
+        0, ITERS, body,
+        (jnp.int32(0), jnp.int32(1), jnp.int32(2), jnp.int32(3)))
+    o_ref[0, 0] = a + b + c + d
+
+
+def k_copy4_wide(o_ref, s):
+    """Match-copy 4 bytes per iteration (unrolled)."""
+    init(s, 4096)
+
+    def body(i, acc):
+        base = (i * 4) & 4095
+        for j in range(4):
+            s[(base + j) & 4095] = s[(base + j - 64) & 4095]
+        return acc + s[base & 4095]
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_cond_overhead(o_ref, s):
+    """lax.cond per iteration (branch cost probe)."""
+    init(s)
+
+    def body(i, acc):
+        return jax.lax.cond(
+            (i & 1) == 0,
+            lambda a: a + s[i & 1023],
+            lambda a: a + s[(i * 3) & 1023] + 1,
+            acc,
+        )
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_select_both(o_ref, s):
+    """Same two paths, both computed, jnp.where select."""
+    init(s)
+
+    def body(i, acc):
+        a = acc + s[i & 1023]
+        b = acc + s[(i * 3) & 1023] + 1
+        return jnp.where((i & 1) == 0, a, b)
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+run("read8_indep", k_read8_indep, ITERS, S1K)
+run("read8_chain", k_read8_chain, ITERS, S1K)
+run("mixed8 (4r+4w)", k_mixed8, ITERS,
+    [pltpu.SMEM((1024,), jnp.int32), pltpu.SMEM((1024,), jnp.int32)])
+run("chase2", k_chase2, ITERS, S1K)
+run("chase4", k_chase4, ITERS, S1K)
+run("copy4_wide", k_copy4_wide, ITERS, [pltpu.SMEM((4096,), jnp.int32)])
+run("cond_overhead", k_cond_overhead, ITERS, S1K)
+run("select_both", k_select_both, ITERS, S1K)
+
+# SMEM ceiling
+for kb in (512, 640, 768, 1024):
+    n = kb * 256
+
+    def k_smem(o_ref, s, _n=n):
+        s[0] = jnp.int32(7)
+        s[_n - 1] = jnp.int32(9)
+        o_ref[0, 0] = s[0] + s[_n - 1]
+
+    run(f"smem_alloc_{kb}KB", k_smem, 1, [pltpu.SMEM((n,), jnp.int32)], reps=1)
+print("probe4 done")

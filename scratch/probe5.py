@@ -1,0 +1,180 @@
+"""Round 3, probe 5: validate the flattened-decoder design before building.
+
+1. DMA directions the kernel needs: VMEM in-block -> SMEM scratch, and big
+   2D SMEM scratch -> VMEM out-block.
+2. A flattened literal-decode-shaped loop (select-refill, 2-level table,
+   gated store, no nested while) -- projected ~40-50 ns/symbol.
+3. The same loop interleaved over 4 independent streams -- projected
+   ~2.5-3x throughput.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timeit(name, f, args, iters, reps=10):
+    try:
+        f(*args).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:24s}: FAIL {str(e).splitlines()[0][:130]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*args)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:24s}: {dt*1e9/iters:8.2f} ns/iter  (total {dt*1e3:.3f} ms)")
+
+
+# ---- 1a: VMEM -> SMEM DMA --------------------------------------------------
+def k_v2s(x_ref, o_ref, s, sem):
+    cp = pltpu.make_async_copy(x_ref, s, sem)
+    cp.start()
+    cp.wait()
+    o_ref[0, 0] = s[0, 0] + s[135, 127]
+
+
+x = jnp.asarray(np.arange(136 * 128).reshape(136, 128), jnp.int32)
+try:
+    out = pl.pallas_call(
+        k_v2s,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((136, 128), jnp.int32),
+                        pltpu.SemaphoreType.DMA],
+    )(x)
+    want = 0 + 136 * 128 - 1
+    print(f"dma_vmem_to_smem: {'OK' if int(out[0,0]) == want else 'WRONG VALUES'}")
+except Exception as e:  # noqa: BLE001
+    print(f"dma_vmem_to_smem: FAIL {str(e).splitlines()[0][:130]}")
+
+# ---- 1b: big SMEM -> VMEM DMA ---------------------------------------------
+def k_s2v(o_ref, s, sem):
+    def fill(i, c):
+        s[i >> 7, i & 127] = i
+        return c
+
+    jax.lax.fori_loop(0, 520 * 128, fill, 0, unroll=8)
+    cp = pltpu.make_async_copy(s, o_ref, sem)
+    cp.start()
+    cp.wait()
+
+
+try:
+    out = pl.pallas_call(
+        k_s2v,
+        out_shape=jax.ShapeDtypeStruct((520, 128), jnp.int32),
+        scratch_shapes=[pltpu.SMEM((520, 128), jnp.int32),
+                        pltpu.SemaphoreType.DMA],
+    )()
+    ok = (np.asarray(out).reshape(-1) == np.arange(520 * 128)).all()
+    print(f"dma_smem_to_vmem_big: {'OK' if ok else 'WRONG VALUES'}")
+except Exception as e:  # noqa: BLE001
+    print(f"dma_smem_to_vmem_big: FAIL {str(e).splitlines()[0][:130]}")
+
+
+# ---- 2: flattened literal-decode-shaped loop -------------------------------
+NSYM = 100_000
+
+
+def flat_body(comp, tab, out, st):
+    """One flattened symbol step: select-refill, root+sub table read,
+    entry unpack, consume, gated store."""
+    n, hpos, buf, nbits, op, err = st
+    # select-refill (no nested loop)
+    w = comp[(hpos >> 1) & 2047]
+    half = jax.lax.shift_right_logical(w, (hpos & 1) * 16) & 0xFFFF
+    need = nbits <= 16
+    buf = jnp.where(need, buf | (half << nbits), buf)
+    nbits = jnp.where(need, nbits + 16, nbits)
+    hpos = hpos + need.astype(jnp.int32)
+    # two-level table
+    e = tab[buf & 511]
+    is_sub = ((e >> 5) & 3) == 1
+    e2 = tab[(jax.lax.shift_right_logical(e, 8)
+              + (jax.lax.shift_right_logical(buf, 9) & 63)) & 8191]
+    e = jnp.where(is_sub, e2, e)
+    bits = e & 31
+    sym = jax.lax.shift_right_logical(e, 8) & 511
+    err = err | jnp.where(bits == 0, 3, 0)
+    buf = jax.lax.shift_right_logical(buf, bits)
+    nbits = nbits - bits
+    # gated store (trash slot at 65536)
+    is_lit = sym < 256
+    addr = jnp.where(is_lit, op & 65535, 65536)
+    out[addr >> 7, addr & 127] = sym & 255
+    op = op + is_lit.astype(jnp.int32)
+    return n + 1, hpos, buf, nbits, op, err
+
+
+def k_flat(comp_in, tab_in, o_ref, comp, tab, out):
+    def ld(i, c):
+        comp[i] = comp_in[i >> 7, i & 127]
+        tab[i] = tab_in[i >> 7, i & 127]
+        return c
+
+    jax.lax.fori_loop(0, 2048, ld, 0)
+
+    def cond(st):
+        return (st[0] < NSYM) & (st[5] == 0)
+
+    st = jax.lax.while_loop(
+        cond, lambda st: flat_body(comp, tab, out, st),
+        (jnp.int32(0), jnp.int32(2), jnp.int32(-1), jnp.int32(32),
+         jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = st[4] + st[2]
+
+
+rng = np.random.default_rng(0)
+comp_in = jnp.asarray(rng.integers(0, 2**31, (16, 128)), jnp.int32)
+# table whose entries are always short literals (bits 7..9, sym<256)
+ent = (rng.integers(0, 256, 2048) << 8) | rng.integers(7, 10, 2048)
+tab_in = jnp.asarray(ent.reshape(16, 128), jnp.int32)
+
+f_flat = jax.jit(lambda a, b: pl.pallas_call(
+    k_flat, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+    out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    scratch_shapes=[pltpu.SMEM((2048,), jnp.int32),
+                    pltpu.SMEM((8192,), jnp.int32),
+                    pltpu.SMEM((520, 128), jnp.int32)],
+)(jnp.tile(a, (1, 1)), b))
+timeit("flat_symbol", f_flat, (comp_in, tab_in), NSYM)
+
+
+# ---- 3: 4-way interleaved version ------------------------------------------
+def k_flat4(comp_in, tab_in, o_ref, comp, tab, out):
+    def ld(i, c):
+        comp[i] = comp_in[i >> 7, i & 127]
+        tab[i] = tab_in[i >> 7, i & 127]
+        return c
+
+    jax.lax.fori_loop(0, 2048, ld, 0)
+
+    def cond(st):
+        return (st[0][0] < NSYM) & (st[0][5] == 0)
+
+    def body(sts):
+        return tuple(flat_body(comp, tab, out, st) for st in sts)
+
+    init = tuple(
+        (jnp.int32(0), jnp.int32(2 + 7 * j), jnp.int32(-1), jnp.int32(32),
+         jnp.int32(j * 16384), jnp.int32(0))
+        for j in range(4)
+    )
+    sts = jax.lax.while_loop(cond, body, init)
+    o_ref[0, 0] = sum(st[4] + st[2] for st in sts)
+
+
+f_flat4 = jax.jit(lambda a, b: pl.pallas_call(
+    k_flat4, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+    out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    scratch_shapes=[pltpu.SMEM((2048,), jnp.int32),
+                    pltpu.SMEM((8192,), jnp.int32),
+                    pltpu.SMEM((520, 128), jnp.int32)],
+)(a, b))
+timeit("flat_symbol_x4 (4 syms)", f_flat4, (comp_in, tab_in), NSYM)
+print("probe5 done")

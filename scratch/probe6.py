@@ -1,0 +1,216 @@
+"""Round 3, probe 6: bisect the Mosaic compile crash in the flattened loop."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NSYM = 100_000
+
+
+def run(name, kernel, scratches, iters=NSYM, reps=10):
+    f = jax.jit(lambda: pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=scratches,
+    )())
+    try:
+        f().block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:28s}: FAIL {str(e).splitlines()[0][:120]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f()
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:28s}: {dt*1e9/iters:8.2f} ns/iter (res {int(r[0,0])})")
+
+
+def init1d(s, n):
+    def body(i, c):
+        s[i] = (i * 37 + 11) & 0x7FFFFFFF
+        return c
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+# v0: minimal while loop, 1D scratch, no shifts
+def k_v0(o_ref, s):
+    init1d(s, 2048)
+
+    def cond(st):
+        return st[0] < NSYM
+
+    def body(st):
+        n, acc = st
+        return n + 1, acc + s[n & 2047]
+
+    _, acc = jax.lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = acc
+
+
+# v1: + dynamic logical shift by data-dependent amount
+def k_v1(o_ref, s):
+    init1d(s, 2048)
+
+    def cond(st):
+        return st[0] < NSYM
+
+    def body(st):
+        n, acc = st
+        w = s[n & 2047]
+        half = jax.lax.shift_right_logical(w, (n & 1) * 16) & 0xFFFF
+        return n + 1, acc + half
+
+    _, acc = jax.lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = acc
+
+
+# v2: + select-refill state updates (6-tuple carry)
+def k_v2(o_ref, s):
+    init1d(s, 2048)
+
+    def cond(st):
+        return (st[0] < NSYM) & (st[5] == 0)
+
+    def body(st):
+        n, hpos, buf, nbits, op, err = st
+        w = s[(hpos >> 1) & 2047]
+        half = jax.lax.shift_right_logical(w, (hpos & 1) * 16) & 0xFFFF
+        need = nbits <= 16
+        buf = jnp.where(need, buf | (half << nbits), buf)
+        nbits = jnp.where(need, nbits + 16, nbits)
+        hpos = hpos + need.astype(jnp.int32)
+        buf = jax.lax.shift_right_logical(buf, 9)
+        nbits = nbits - 9
+        return n + 1, hpos, buf, nbits, op + 1, err
+
+    st = jax.lax.while_loop(
+        cond, lambda st: body(st),
+        (jnp.int32(0), jnp.int32(2), jnp.int32(-1), jnp.int32(32),
+         jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = st[4] + st[2]
+
+
+# v3: + chained two-level table reads
+def k_v3(o_ref, s, tab):
+    init1d(s, 2048)
+    init1d(tab, 8192)
+
+    def cond(st):
+        return (st[0] < NSYM) & (st[5] == 0)
+
+    def body(st):
+        n, hpos, buf, nbits, op, err = st
+        w = s[(hpos >> 1) & 2047]
+        half = jax.lax.shift_right_logical(w, (hpos & 1) * 16) & 0xFFFF
+        need = nbits <= 16
+        buf = jnp.where(need, buf | (half << nbits), buf)
+        nbits = jnp.where(need, nbits + 16, nbits)
+        hpos = hpos + need.astype(jnp.int32)
+        e = tab[buf & 511]
+        is_sub = ((e >> 5) & 3) == 1
+        e2 = tab[(jax.lax.shift_right_logical(e, 8)
+                  + (jax.lax.shift_right_logical(buf, 9) & 63)) & 8191]
+        e = jnp.where(is_sub, e2, e)
+        bits = (e & 7) + 7
+        err = err | jnp.where(bits == 0, 3, 0)
+        buf = jax.lax.shift_right_logical(buf, bits)
+        nbits = nbits - bits
+        return n + 1, hpos, buf, nbits, op + 1, err
+
+    st = jax.lax.while_loop(
+        cond, lambda st: body(st),
+        (jnp.int32(0), jnp.int32(2), jnp.int32(-1), jnp.int32(32),
+         jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = st[4] + st[2]
+
+
+# v4: + 2D dynamic SMEM store into big (520,128) buffer
+def k_v4(o_ref, s, tab, out):
+    init1d(s, 2048)
+    init1d(tab, 8192)
+
+    def cond(st):
+        return (st[0] < NSYM) & (st[5] == 0)
+
+    def body(st):
+        n, hpos, buf, nbits, op, err = st
+        w = s[(hpos >> 1) & 2047]
+        half = jax.lax.shift_right_logical(w, (hpos & 1) * 16) & 0xFFFF
+        need = nbits <= 16
+        buf = jnp.where(need, buf | (half << nbits), buf)
+        nbits = jnp.where(need, nbits + 16, nbits)
+        hpos = hpos + need.astype(jnp.int32)
+        e = tab[buf & 511]
+        is_sub = ((e >> 5) & 3) == 1
+        e2 = tab[(jax.lax.shift_right_logical(e, 8)
+                  + (jax.lax.shift_right_logical(buf, 9) & 63)) & 8191]
+        e = jnp.where(is_sub, e2, e)
+        bits = (e & 7) + 7
+        sym = jax.lax.shift_right_logical(e, 8) & 511
+        buf = jax.lax.shift_right_logical(buf, bits)
+        nbits = nbits - bits
+        is_lit = sym < 256
+        addr = jnp.where(is_lit, op & 65535, 65536)
+        out[addr >> 7, addr & 127] = sym & 255
+        op = op + is_lit.astype(jnp.int32)
+        return n + 1, hpos, buf, nbits, op, err
+
+    st = jax.lax.while_loop(
+        cond, lambda st: body(st),
+        (jnp.int32(0), jnp.int32(2), jnp.int32(-1), jnp.int32(32),
+         jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = st[4] + st[2]
+
+
+# v4b: same but 1D out buffer
+def k_v4b(o_ref, s, tab, out):
+    init1d(s, 2048)
+    init1d(tab, 8192)
+
+    def cond(st):
+        return (st[0] < NSYM) & (st[5] == 0)
+
+    def body(st):
+        n, hpos, buf, nbits, op, err = st
+        w = s[(hpos >> 1) & 2047]
+        half = jax.lax.shift_right_logical(w, (hpos & 1) * 16) & 0xFFFF
+        need = nbits <= 16
+        buf = jnp.where(need, buf | (half << nbits), buf)
+        nbits = jnp.where(need, nbits + 16, nbits)
+        hpos = hpos + need.astype(jnp.int32)
+        e = tab[buf & 511]
+        is_sub = ((e >> 5) & 3) == 1
+        e2 = tab[(jax.lax.shift_right_logical(e, 8)
+                  + (jax.lax.shift_right_logical(buf, 9) & 63)) & 8191]
+        e = jnp.where(is_sub, e2, e)
+        bits = (e & 7) + 7
+        sym = jax.lax.shift_right_logical(e, 8) & 511
+        buf = jax.lax.shift_right_logical(buf, bits)
+        nbits = nbits - bits
+        is_lit = sym < 256
+        addr = jnp.where(is_lit, op & 16383, 16384)
+        out[addr] = sym & 255
+        op = op + is_lit.astype(jnp.int32)
+        return n + 1, hpos, buf, nbits, op, err
+
+    st = jax.lax.while_loop(
+        cond, lambda st: body(st),
+        (jnp.int32(0), jnp.int32(2), jnp.int32(-1), jnp.int32(32),
+         jnp.int32(0), jnp.int32(0)))
+    o_ref[0, 0] = st[4] + st[2]
+
+
+S = pltpu.SMEM
+run("v0_minimal_while", k_v0, [S((2048,), jnp.int32)])
+run("v1_dyn_shift", k_v1, [S((2048,), jnp.int32)])
+run("v2_select_refill", k_v2, [S((2048,), jnp.int32)])
+run("v3_two_level_tab", k_v3, [S((2048,), jnp.int32), S((8192,), jnp.int32)])
+run("v4_2d_store", k_v4,
+    [S((2048,), jnp.int32), S((8192,), jnp.int32), S((520, 128), jnp.int32)])
+run("v4b_1d_store", k_v4b,
+    [S((2048,), jnp.int32), S((8192,), jnp.int32), S((16400,), jnp.int32)])
+print("probe6 done")

@@ -1,0 +1,132 @@
+"""Round 3, probe 7: are data-dependent scalar shifts the ~150ns culprit?
+
+probe6: v0 (no shifts) fast, v1..v4 (dynamic shifts) all ~130-165 ns/iter.
+Compare a pointer-chase baseline against + dynamic shift, + barrel-select
+shift (4 selects of static shifts), + parity-select halfword extract.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ITERS = 250_000
+
+
+def run(name, kernel, scratches, iters=ITERS, reps=10):
+    f = jax.jit(lambda: pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=scratches,
+    )())
+    try:
+        f().block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:28s}: FAIL {str(e).splitlines()[0][:120]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f()
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:28s}: {dt*1e9/iters:8.2f} ns/iter (res {int(r[0,0])})")
+
+
+def init1d(s, n=1024):
+    def body(i, c):
+        s[i] = (i * 37 + 11) & 1023
+        return c
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def srl(x, k):
+    return jax.lax.shift_right_logical(x, k)
+
+
+def barrel_srl(x, k):
+    """Logical right shift by dynamic k in [0,31] via static shifts."""
+    x = jnp.where((k & 16) != 0, srl(x, 16), x)
+    x = jnp.where((k & 8) != 0, srl(x, 8), x)
+    x = jnp.where((k & 4) != 0, srl(x, 4), x)
+    x = jnp.where((k & 2) != 0, srl(x, 2), x)
+    return jnp.where((k & 1) != 0, srl(x, 1), x)
+
+
+def barrel_sll(x, k):
+    x = jnp.where((k & 16) != 0, x << 16, x)
+    x = jnp.where((k & 8) != 0, x << 8, x)
+    x = jnp.where((k & 4) != 0, x << 4, x)
+    x = jnp.where((k & 2) != 0, x << 2, x)
+    return jnp.where((k & 1) != 0, x << 1, x)
+
+
+def k_chase(o_ref, s):
+    init1d(s)
+
+    def body(i, acc):
+        return s[(acc + i) & 1023] + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_chase_dynshift(o_ref, s):
+    init1d(s)
+
+    def body(i, acc):
+        v = s[(acc + i) & 1023]
+        return srl(v, acc & 7) + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_chase_barrel(o_ref, s):
+    init1d(s)
+
+    def body(i, acc):
+        v = s[(acc + i) & 1023]
+        return barrel_srl(v, acc & 7) + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_chase_parity(o_ref, s):
+    init1d(s)
+
+    def body(i, acc):
+        v = s[(acc + i) & 1023]
+        half = jnp.where((acc & 1) != 0, srl(v, 16), v) & 0xFFFF
+        return half + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_chase_dynshift_l(o_ref, s):
+    init1d(s)
+
+    def body(i, acc):
+        v = s[(acc + i) & 1023]
+        return (v << (acc & 7)) + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+def k_chase_barrel_l(o_ref, s):
+    init1d(s)
+
+    def body(i, acc):
+        v = s[(acc + i) & 1023]
+        return barrel_sll(v, acc & 7) + acc
+
+    o_ref[0, 0] = jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+
+S = pltpu.SMEM
+run("chase_baseline", k_chase, [S((1024,), jnp.int32)])
+run("chase_dyn_srl", k_chase_dynshift, [S((1024,), jnp.int32)])
+run("chase_barrel_srl", k_chase_barrel, [S((1024,), jnp.int32)])
+run("chase_parity_sel", k_chase_parity, [S((1024,), jnp.int32)])
+run("chase_dyn_sll", k_chase_dynshift_l, [S((1024,), jnp.int32)])
+run("chase_barrel_sll", k_chase_barrel_l, [S((1024,), jnp.int32)])
+print("probe7 done")

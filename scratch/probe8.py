@@ -1,0 +1,162 @@
+"""Round 3, probe 8 (v2): gather menu for the 128-lane SIMD DEFLATE design.
+
+Mosaic's gather lowering requires idx.shape == data.shape. The SIMD design
+stores per-lane streams column-wise as (R, 128) and needs
+out[r,l] = data[idx[r,l], l]  (take_along_axis axis=0, equal shapes).
+Measure correctness + cost vs R, plus the one-hot fallback and the
+uniform-row dynamic store/read the superstep loop uses.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bench(name, fn, args, iters, reps=5, check=None):
+    f = jax.jit(fn)
+    try:
+        r = f(*args)
+        r.block_until_ready()
+        if check is not None and not check(np.asarray(r)):
+            print(f"{name:40s}: WRONG VALUES")
+            return
+    except Exception as e:  # noqa: BLE001
+        msg = (str(e).splitlines() or [type(e).__name__])[0]
+        print(f"{name:40s}: FAIL {msg[:100]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*args)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps / iters
+    print(f"{name:40s}: {dt*1e9:9.1f} ns/op")
+
+
+# ---- axis0 equal-shape: out[r,l] = data[idx[r,l], l] -----------------------
+def make_axis0(R, iters=100):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+
+        def body(_, cur):
+            g = jnp.take_along_axis(d, cur & (R - 1), axis=0)
+            return (g + 1) & (R - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, R, (R, 128)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, R, (R, 128)), jnp.int32)
+
+    # correctness oracle for the chained loop
+    dn, cur = np.asarray(d), np.asarray(idx)
+    for _ in range(iters):
+        cur = (np.take_along_axis(dn, cur & (R - 1), axis=0) + 1) & (R - 1)
+
+    return (lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((R, 128), jnp.int32))(a, b)), \
+        (d, idx), iters, (lambda got, exp=cur: (got == exp).all())
+
+
+for R in (8, 128, 512, 1024, 4096, 32768):
+    fn, args, iters, chk = make_axis0(R)
+    bench(f"axis0 eq-shape ({R},128)", fn, args, iters, check=chk)
+
+
+# ---- axis1 equal-shape with C>128 (row-per-lane layout) --------------------
+def make_axis1(C, iters=100):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+
+        def body(_, cur):
+            g = jnp.take_along_axis(d, cur & (C - 1), axis=1)
+            return (g + 1) & (C - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, C, (128, C)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, C, (128, C)), jnp.int32)
+    dn, cur = np.asarray(d), np.asarray(idx)
+    for _ in range(iters):
+        cur = (np.take_along_axis(dn, cur & (C - 1), axis=1) + 1) & (C - 1)
+    return (lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((128, C), jnp.int32))(a, b)), \
+        (d, idx), iters, (lambda got, exp=cur: (got == exp).all())
+
+
+for C in (128, 256, 512):
+    fn, args, iters, chk = make_axis1(C)
+    bench(f"axis1 eq-shape (128,{C})", fn, args, iters, check=chk)
+
+
+# ---- one-hot reduce gather (R,128) by (1,128) ------------------------------
+def make_onehot(R, iters=50):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (R, 128), 0)
+
+        def body(_, cur):
+            g = jnp.sum(jnp.where(rows == cur, d, 0), axis=0, keepdims=True)
+            return (g + 1) & (R - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, R, (R, 128)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, R, (1, 128)), jnp.int32)
+    return (lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b)), \
+        (d, idx), iters
+
+
+for R in (512, 4096):
+    fn, args, iters = make_onehot(R)
+    bench(f"onehot_gather ({R},128) idx(1,128)", fn, args, iters)
+
+
+# ---- elementwise (1,128) chain --------------------------------------------
+def k_chain(x_ref, o_ref):
+    def body(_, v):
+        for j in range(25):
+            v = jnp.where((v & 1) == 0, v + 3, v ^ 5) & 1023
+        return v
+
+    o_ref[...] = jax.lax.fori_loop(0, 400, body, x_ref[...])
+
+
+x = jnp.asarray(np.arange(128).reshape(1, 128), jnp.int32)
+bench("elementwise where (1,128) [per where]", lambda a: pl.pallas_call(
+    k_chain, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a),
+    (x,), 25 * 400)
+
+
+# ---- uniform dynamic-row store + read --------------------------------------
+def k_rowstore(x_ref, o_ref):
+    def body(i, v):
+        o_ref[pl.ds(i & 511, 1), :] = v
+        return v + 1
+
+    jax.lax.fori_loop(0, 10000, body, x_ref[...])
+
+
+bench("dyn row store (1,128)->(512,128)", lambda a: pl.pallas_call(
+    k_rowstore, out_shape=jax.ShapeDtypeStruct((512, 128), jnp.int32))(a),
+    (x,), 10000)
+
+
+def k_rowread(x_ref, d_ref, o_ref):
+    def body(i, v):
+        r = d_ref[pl.ds((v[0, 0] + i) & 511, 1), :]
+        return v + r
+
+    o_ref[...] = jax.lax.fori_loop(0, 10000, body, x_ref[...])
+
+
+d = jnp.asarray(np.random.default_rng(4).integers(0, 3, (512, 128)), jnp.int32)
+bench("dyn row read (512,128) uniform row", lambda a, b: pl.pallas_call(
+    k_rowread, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b),
+    (x, d), 10000)
+print("probe8 done")

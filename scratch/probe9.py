@@ -1,0 +1,193 @@
+"""Round 3, probe 9: launch-amortized costs of the one-hot SIMD primitives.
+
+probe8's numbers were garbage: each pallas_call through the axon tunnel
+costs ~10-30ms, swamping small kernels. Here every measurement runs >=2k
+chained iterations inside ONE kernel so launch cost is <5%.
+
+Menu priced here (the no-gather SIMD DEFLATE superstep):
+  - one-hot gather: out[1,128] = sum_r where(iota==idx, data, 0) for
+    R in {512, 1024, 8192}
+  - vector elementwise chain cost per (1,128) op
+  - uniform dynamic-row store/read
+  - kernel launch floor (empty-ish kernel)
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def bench(name, fn, args, iters, reps=3):
+    f = jax.jit(fn)
+    try:
+        r = f(*args)
+        r.block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        msg = (str(e).splitlines() or [type(e).__name__])[0]
+        print(f"{name:42s}: FAIL {msg[:100]}")
+        return
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*args)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:42s}: {dt*1e9/iters:9.1f} ns/op  (call {dt*1e3:8.2f} ms)")
+
+
+# launch floor
+def k_empty(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + 1
+
+
+x1 = jnp.zeros((1, 128), jnp.int32)
+bench("launch floor", lambda a: pl.pallas_call(
+    k_empty, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a),
+    (x1,), 1)
+
+
+# one-hot gather chained
+def make_onehot(R, iters):
+    def k(d_ref, i_ref, o_ref):
+        d = d_ref[...]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (R, 128), 0)
+
+        def body(_, cur):
+            g = jnp.sum(jnp.where(rows == cur, d, 0), axis=0, keepdims=True)
+            return (g + 1) & (R - 1)
+
+        o_ref[...] = jax.lax.fori_loop(0, iters, body, i_ref[...])
+
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, R, (R, 128)), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, R, (1, 128)), jnp.int32)
+    return (lambda a, b: pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b)), (d, idx)
+
+
+for R, iters in ((512, 20000), (1024, 10000), (8192, 2000)):
+    fn, args = make_onehot(R, iters)
+    bench(f"onehot_gather ({R},128)", fn, args, iters)
+
+
+# elementwise chain: 200k dependent (1,128) wheres
+def k_chain(x_ref, o_ref):
+    def body(_, v):
+        for j in range(50):
+            v = jnp.where((v & 1) == 0, v + 3, v ^ 5) & 1023
+        return v
+
+    o_ref[...] = jax.lax.fori_loop(0, 4000, body, x_ref[...])
+
+
+bench("elementwise where (1,128)", lambda a: pl.pallas_call(
+    k_chain, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a),
+    (x1,), 50 * 4000)
+
+
+# arith chain (add/xor/shift static) per (1,128) op
+def k_chain2(x_ref, o_ref):
+    def body(_, v):
+        for j in range(50):
+            v = (v + 3) ^ (v >> 2)
+        return v
+
+    o_ref[...] = jax.lax.fori_loop(0, 4000, body, x_ref[...])
+
+
+bench("elementwise arith (1,128)", lambda a: pl.pallas_call(
+    k_chain2, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a),
+    (x1,), 50 * 4000)
+
+
+# uniform dynamic-row store, 1M
+def k_rowstore(x_ref, o_ref):
+    def body(i, v):
+        o_ref[pl.ds(i & 511, 1), :] = v
+        return v + 1
+
+    jax.lax.fori_loop(0, 1_000_000, body, x_ref[...])
+    # make sure the loop isn't dead
+    tmp = o_ref[pl.ds(0, 1), :]
+    o_ref[pl.ds(1, 1), :] = tmp
+
+
+bench("dyn row store (1,128)->(512,128)", lambda a: pl.pallas_call(
+    k_rowstore, out_shape=jax.ShapeDtypeStruct((512, 128), jnp.int32))(a),
+    (x1,), 1_000_000)
+
+
+# uniform dynamic-row read, 1M
+def k_rowread(x_ref, d_ref, o_ref):
+    def body(i, v):
+        r = d_ref[pl.ds((v[0, 0] + i) & 511, 1), :]
+        return v + r
+
+    o_ref[...] = jax.lax.fori_loop(0, 1_000_000, body, x_ref[...])
+
+
+d = jnp.asarray(np.random.default_rng(4).integers(0, 3, (512, 128)), jnp.int32)
+bench("dyn row read (512,128)", lambda a, b: pl.pallas_call(
+    k_rowread, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32))(a, b),
+    (x1, d), 1_000_000)
+
+
+# a composite superstep-shaped iteration:
+# refill onehot(512) + lit onehot(512) + dist onehot(512) + near-hist
+# onehot(1024) + ~40 elementwise + 2 row stores
+def k_superstep(c_ref, t_ref, h_ref, o_ref, hist_ref):
+    comp = c_ref[...]
+    tab = t_ref[...]
+    rows512 = jax.lax.broadcasted_iota(jnp.int32, (512, 128), 0)
+    rows1024 = jax.lax.broadcasted_iota(jnp.int32, (1024, 128), 0)
+
+    def oh512(data, idx):
+        return jnp.sum(jnp.where(rows512 == idx, data, 0), axis=0,
+                       keepdims=True)
+
+    def body(i, st):
+        buf, nbits, op, acc = st
+        w = oh512(comp, (op >> 1) & 511)
+        half = jnp.where((op & 1) != 0, w >> 16, w) & 0xFFFF
+        need = nbits <= 16
+        buf = jnp.where(need, buf | (half << (nbits & 15)), buf)
+        nbits = jnp.where(need, nbits + 16, nbits)
+        e = oh512(tab, buf & 511)
+        bits = (e & 7) + 7
+        sym = (e >> 8) & 511
+        # barrel consume (4 static shifts selected)
+        b = buf
+        b = jnp.where((bits & 8) != 0, b >> 8, b)
+        b = jnp.where((bits & 4) != 0, b >> 4, b)
+        b = jnp.where((bits & 2) != 0, b >> 2, b)
+        b = jnp.where((bits & 1) != 0, b >> 1, b)
+        buf = b & 0x7FFFFFFF
+        nbits = nbits - bits
+        de = oh512(tab, buf & 255)
+        hist = h_ref[...]
+        hv = jnp.sum(jnp.where(rows1024 == ((op + de) & 1023), hist, 0),
+                     axis=0, keepdims=True)
+        v = jnp.where(sym < 256, sym, hv & 255)
+        hist_ref[pl.ds(i & 1023, 1), :] = v
+        op = op + 1
+        return buf, nbits, op, acc + v
+
+    st = (jnp.full((1, 128), -1, jnp.int32), jnp.full((1, 128), 32, jnp.int32),
+          jnp.zeros((1, 128), jnp.int32), jnp.zeros((1, 128), jnp.int32))
+    _, _, _, acc = jax.lax.fori_loop(0, 5000, body, st)
+    o_ref[...] = acc
+
+
+rng = np.random.default_rng(7)
+comp = jnp.asarray(rng.integers(0, 2**31, (512, 128)), jnp.int32)
+ent = jnp.asarray(((rng.integers(0, 512, (512, 128))) << 8)
+                  | rng.integers(0, 8, (512, 128)), jnp.int32)
+hist0 = jnp.asarray(rng.integers(0, 256, (1024, 128)), jnp.int32)
+bench("superstep composite (5k steps)", lambda a, b, c: pl.pallas_call(
+    k_superstep,
+    out_shape=[jax.ShapeDtypeStruct((1, 128), jnp.int32),
+               jax.ShapeDtypeStruct((1024, 128), jnp.int32)],
+)(a, b, c)[0], (comp, ent, hist0), 5000)
+print("probe9 done")
