@@ -1,18 +1,33 @@
 #!/usr/bin/env python
-"""Benchmark harness — BASELINE.md measurement matrix, config 1:
-BAM decode records/sec (read().count() equivalent) plus the sort stage.
+"""Benchmark harness — BASELINE.md measurement matrix.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
-baseline is measured in-process: a sequential record-at-a-time decode of
-the same file — the htsjdk/per-record-object execution model that disq
-delegates to (SURVEY.md §2.8). vs_baseline = columnar_rps / sequential_rps.
+Measurement protocol (VERDICT r4 item 2 — repeatability):
+
+- Every timed quantity is measured ``REPS`` times after a warm-up run;
+  the reported value is the **median** and the JSON carries the spread
+  ``(max - min) / median`` plus the raw per-rep numbers, so a single
+  noisy run can never masquerade as a regression (judge-measured 3.5x
+  run-to-run variance on this box with the old single-run harness).
+- ``vs_baseline`` compares medians.
+
+Baseline (the thing disq actually delegates to, SURVEY.md §2.8): an
+htsjdk-style record-at-a-time object decode — but run on **all cores**
+via multiprocessing, with record-aligned splits taken from the SBI
+index exactly the way disq's Spark executors take them. The previous
+single-threaded strawman flattered the framework; this one does not.
+
+Per-config results live under ``"configs"`` in the same JSON line; the
+primary metric stays config 1 (BAM decode records/sec) for
+round-over-round comparability.
 """
 
 import json
+import multiprocessing
 import os
+import statistics
 import struct
 import sys
 import tempfile
@@ -22,6 +37,8 @@ import zlib
 import numpy as np
 
 N_RECORDS = int(os.environ.get("BENCH_RECORDS", "300000"))
+REPS = int(os.environ.get("BENCH_REPS", "5"))
+BASE_REPS = int(os.environ.get("BENCH_BASE_REPS", "3"))
 REFS = [("chr1", 248_956_422), ("chr2", 242_193_529), ("chr20", 64_444_167)]
 
 
@@ -64,38 +81,59 @@ def synth_bam(path: str, n: int) -> None:
     BamSink(_Cfg()).save(ds, path, (SbiWriteOption.ENABLE,))
 
 
-def sequential_baseline_decode(path: str) -> int:
-    """The baseline execution model: stream-inflate + per-record object
-    decode, one record at a time (htsjdk-style). Returns record count."""
-    out_count = 0
-    with open(path, "rb") as f:
+# ---------------------------------------------------------------------------
+# Baseline: htsjdk-style per-record object decode, all cores, SBI splits.
+# Self-contained (stdlib only) so workers never import the framework.
+# ---------------------------------------------------------------------------
+
+_SBI_HEADER_FMT = "<4sQ16s16sQQQ"
+
+
+def _read_sbi_offsets(path: str):
+    with open(path + ".sbi", "rb") as f:
         data = f.read()
-    # sequential BGZF walk
+    magic, _flen, _md5, _uuid, _total, _gran, n = struct.unpack_from(
+        _SBI_HEADER_FMT, data
+    )
+    assert magic == b"SBI\x01"
+    return struct.unpack_from(
+        "<%dQ" % n, data, struct.calcsize(_SBI_HEADER_FMT)
+    )
+
+
+def _inflate_range(data: bytes, cend_incl: int, uend: int) -> bytes:
+    """Inflate BGZF blocks from ``data[0]`` up to (and when ``uend > 0``
+    partially including) the block at offset ``cend_incl``."""
+    out = bytearray()
     pos = 0
-    payload = bytearray()
-    while pos < len(data):
-        if data[pos:pos + 4] != b"\x1f\x8b\x08\x04":
-            raise ValueError("bad block")
+    while pos < cend_incl:
         xlen = struct.unpack_from("<H", data, pos + 10)[0]
-        bsize = None
-        p = pos + 12
-        while p < pos + 12 + xlen:
-            si1, si2, slen = data[p], data[p + 1], struct.unpack_from("<H", data, p + 2)[0]
-            if si1 == 0x42 and si2 == 0x43:
-                bsize = struct.unpack_from("<H", data, p + 4)[0] + 1
-            p += 4 + slen
+        bsize = struct.unpack_from("<H", data, pos + 16)[0] + 1
         comp = data[pos + 12 + xlen: pos + bsize - 8]
-        payload += zlib.decompress(comp, wbits=-15)
+        out += zlib.decompress(comp, wbits=-15)
         pos += bsize
-    # skip header
-    (l_text,) = struct.unpack_from("<i", payload, 4)
-    p = 8 + l_text
-    (n_ref,) = struct.unpack_from("<i", payload, p)
-    p += 4
-    for _ in range(n_ref):
-        (l_name,) = struct.unpack_from("<i", payload, p)
-        p += 4 + l_name + 4
-    # per-record decode: parse every field into Python objects
+    if uend > 0:
+        xlen = struct.unpack_from("<H", data, pos + 10)[0]
+        bsize = struct.unpack_from("<H", data, pos + 16)[0] + 1
+        comp = data[pos + 12 + xlen: pos + bsize - 8]
+        out += zlib.decompress(comp, wbits=-15)[:uend]
+    return bytes(out)
+
+
+def _baseline_worker(args) -> int:
+    """One executor: inflate its record-aligned split, decode every record
+    into Python objects (htsjdk execution model), return the count."""
+    path, vstart, vend = args
+    cstart, ustart = vstart >> 16, vstart & 0xFFFF
+    cend, uend = vend >> 16, vend & 0xFFFF
+    # Read only this split's byte range (+1 BGZF block bound for the
+    # partially-consumed end block) — executors never hold the whole file.
+    with open(path, "rb") as f:
+        f.seek(cstart)
+        data = f.read(cend - cstart + (0x10000 if uend else 0))
+    payload = _inflate_range(data, cend - cstart, uend)
+    p = ustart
+    count = 0
     while p < len(payload):
         (block_size,) = struct.unpack_from("<i", payload, p)
         refid, rpos, l_name, mapq, b, n_cig, flag, l_seq = struct.unpack_from(
@@ -111,9 +149,48 @@ def sequential_baseline_decode(path: str) -> int:
         _seq = bytes(payload[q: q + (l_seq + 1) // 2])
         q += (l_seq + 1) // 2
         _qual = bytes(payload[q: q + l_seq])
-        out_count += 1
+        count += 1
         p += 4 + block_size
-    return out_count
+    return count
+
+
+def baseline_decode(pool, path: str, splits) -> int:
+    return sum(pool.map(_baseline_worker, splits))
+
+
+def make_splits(path: str, n_splits: int):
+    """Record-aligned splits from the SBI index (disq's own split scheme)."""
+    # offsets[0] is the first record's virtual offset (past the BAM
+    # header); the final entry is end-of-data. n_splits+1 fenceposts.
+    offsets = _read_sbi_offsets(path)
+    idx = np.linspace(0, len(offsets) - 1, n_splits + 1).round().astype(int)
+    marks = [offsets[i] for i in idx]
+    return [
+        (path, marks[i], marks[i + 1])
+        for i in range(n_splits)
+        if marks[i] < marks[i + 1]
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Measurement
+# ---------------------------------------------------------------------------
+
+
+def _timed(fn, reps: int):
+    """Run ``fn`` reps times (after the caller's warm-up); return
+    (median_seconds, [seconds...])."""
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), times
+
+
+def _spread(times) -> float:
+    med = statistics.median(times)
+    return round((max(times) - min(times)) / med, 3) if med else 0.0
 
 
 def main() -> None:
@@ -123,23 +200,41 @@ def main() -> None:
 
     from disq_tpu import ReadsStorage
 
-    # warm-up (compile caches, page cache)
     storage = ReadsStorage.make_default().split_size(8 * 1024 * 1024)
-    ds = storage.read(path)
-    assert ds.count() == N_RECORDS
 
-    t0 = time.perf_counter()
-    ds = storage.read(path)
-    n = ds.count()
-    dt_columnar = time.perf_counter() - t0
+    # --- framework: config 1, BAM decode records/sec ---
+    def run_framework():
+        ds = storage.read(path)
+        assert ds.count() == N_RECORDS
 
-    t0 = time.perf_counter()
-    n_seq = sequential_baseline_decode(path)
-    dt_seq = time.perf_counter() - t0
-    assert n == n_seq == N_RECORDS
+    run_framework()  # warm-up (compile caches, page cache)
+    med_fw, times_fw = _timed(run_framework, REPS)
 
-    rps = n / dt_columnar
-    baseline_rps = n_seq / dt_seq
+    # --- baseline: all-core htsjdk-style decode over SBI splits ---
+    ncpu = os.cpu_count() or 1
+    splits = make_splits(path, ncpu)
+    ctx = multiprocessing.get_context("spawn")
+    with ctx.Pool(ncpu) as pool:
+        n_base = baseline_decode(pool, path, splits)  # warm-up
+        assert n_base == N_RECORDS, f"baseline decoded {n_base}"
+        med_base, times_base = _timed(
+            lambda: baseline_decode(pool, path, splits), BASE_REPS
+        )
+
+    rps = N_RECORDS / med_fw
+    baseline_rps = N_RECORDS / med_base
+
+    configs = {
+        "1_bam_decode": {
+            "records_per_sec": round(rps, 1),
+            "spread": _spread(times_fw),
+            "reps_sec": [round(t, 4) for t in times_fw],
+            "baseline_records_per_sec": round(baseline_rps, 1),
+            "baseline_spread": _spread(times_base),
+            "baseline_cores": ncpu,
+        },
+    }
+
     print(
         json.dumps(
             {
@@ -147,6 +242,9 @@ def main() -> None:
                 "value": round(rps, 1),
                 "unit": "records/sec",
                 "vs_baseline": round(rps / baseline_rps, 3),
+                "spread": _spread(times_fw),
+                "reps": REPS,
+                "configs": configs,
             }
         )
     )
