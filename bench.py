@@ -86,19 +86,13 @@ def synth_bam(path: str, n: int) -> None:
 # Self-contained (stdlib only) so workers never import the framework.
 # ---------------------------------------------------------------------------
 
-_SBI_HEADER_FMT = "<4sQ16s16sQQQ"
-
-
 def _read_sbi_offsets(path: str):
+    """Record-aligned virtual offsets from the SBI index. Parsed with
+    the framework reader — only the *workers* must stay stdlib-only."""
+    from disq_tpu.index.sbi import SbiIndex
+
     with open(path + ".sbi", "rb") as f:
-        data = f.read()
-    magic, _flen, _md5, _uuid, _total, _gran, n = struct.unpack_from(
-        _SBI_HEADER_FMT, data
-    )
-    assert magic == b"SBI\x01"
-    return struct.unpack_from(
-        "<%dQ" % n, data, struct.calcsize(_SBI_HEADER_FMT)
-    )
+        return SbiIndex.from_bytes(f.read()).offsets.tolist()
 
 
 def _inflate_range(data: bytes, cend_incl: int, uend: int) -> bytes:

@@ -62,8 +62,10 @@ def inflate_blocks(
     Uses the threaded C++ batch inflater when built (blocks are
     independent raw-DEFLATE streams — embarrassingly parallel); falls
     back to per-block host zlib. Set ``DISQ_TPU_DEVICE_INFLATE=1`` to
-    route through the Pallas inflate kernel instead
-    (``disq_tpu.ops.inflate`` — the device path; CRC checked on host).
+    route through the 128-lane SIMD Pallas kernel instead
+    (``disq_tpu.ops.inflate_simd`` — the device path; CRC checked on
+    host), or ``=legacy`` for the round-1 scalar kernel
+    (``disq_tpu.ops.inflate``).
     """
     import numpy as np
 
@@ -102,10 +104,19 @@ def inflate_blocks_device(
     data: bytes, blocks: Sequence[BgzfBlock], base: int = 0,
     verify_crc: bool = True,
 ) -> bytes:
-    """Device path of ``inflate_blocks``: the Pallas raw-DEFLATE kernel
-    (one block per grid program) with ISIZE validated in-kernel and CRC
-    on host."""
-    from disq_tpu.ops.inflate import inflate_payloads
+    """Device path of ``inflate_blocks``: the 128-lane SIMD Pallas
+    kernel (``ops/inflate_simd``, the PROBES.md design) with ISIZE
+    validated against the kernel's per-lane output length and CRC on
+    host. ``DISQ_TPU_DEVICE_INFLATE=legacy`` selects the round-1
+    one-block-per-grid-program kernel (``ops/inflate``) for A/B runs."""
+    import os
+
+    if os.environ.get("DISQ_TPU_DEVICE_INFLATE", "").lower() == "legacy":
+        from disq_tpu.ops.inflate import inflate_payloads
+    else:
+        from disq_tpu.ops.inflate_simd import (
+            inflate_payloads_simd as inflate_payloads,
+        )
 
     if not blocks:
         return b""

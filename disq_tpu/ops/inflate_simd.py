@@ -1,0 +1,696 @@
+"""128-lane SIMD raw-DEFLATE inflate — the PROBES.md redesign.
+
+The north-star device codec (SURVEY.md §2.8 row 1, §7 step 2; reference
+behavior: htsjdk ``BlockCompressedInputStream`` + zlib ``Inflater``).
+The round-1 kernel (``ops/inflate.py``) decodes one block per grid
+program with a *scalar* state machine and is latency-bound at ~0.9 MB/s
+on a real chip; PROBES.md measures the scalar-core wall (~150 ns per
+data-dependent SMEM step) and concludes the only viable architecture is
+**lane-parallel SIMD**: 128 independent DEFLATE streams, one per vector
+lane, every piece of decoder state a ``(1, 128)`` vector.
+
+Per superstep (one ``lax.while_loop`` iteration), every lane advances
+its own predicated state machine — header / stored / dynamic-table
+build / symbol decode / distance / LZ77 copy — by pure vector selects;
+there is no ``lax.cond`` on the hot path (only rare events like table
+finalization are gated with ``pl.when``). Each lane emits at most one
+output byte per superstep. All data-dependent indexing uses the one
+vector-gather primitive PROBES.md proved both correct and fast on the
+VPU: the one-hot row gather ``sum(where(row_iota == idx, data, 0))``
+(54 ns over (512,128); ``take_along_axis``/1-D gathers miscompile or
+crash Mosaic).
+
+Huffman decoding is bit-serial canonical (puff-style count/first/offset
+walk) rather than root-table driven: the per-length arrays are (16,128)
+columns read at *compile-time* row indices inside the unrolled 15-step
+code walk (free), leaving exactly one one-hot gather per symbol (the
+sorted-symbol table). This removes the 512-entry per-lane root-table
+construction sweep entirely — dynamic table build reduces to counting
+sorts over the code-length arrays.
+
+Memory (v1): compressed words, output words and all tables live whole
+in VMEM; history reads and output writes are one-hot sweeps over the
+full (OW,128) output. Correct and Mosaic-friendly, but the sweeps scale
+with buffer size — the measured-ring layout from PROBES.md (per-lane
+comp ring + tiered history + column-DMA refill) replaces them in the
+optimization pass.
+
+Error codes in meta row 1 (shared with ``ops/inflate.py``): 0 ok ·
+1 bad btype · 2 stored-LEN mismatch · 3 bad Huffman code · 4 invalid
+distance · 5 output overflow · 6 ran past the compressed payload ·
+7 code-length repeat overflow · 8 ISIZE mismatch (host-side).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from disq_tpu.ops.inflate import (
+    _CLORDER,
+    _DBASE,
+    _DEXT,
+    _FIXED_LENS,
+    _LBASE,
+    _LEXT,
+    _NLIT,
+)
+
+LANES = 128
+_MAXLENS = 320          # 288 lit/len + 32 dist code lengths
+_U32 = jnp.uint32
+_I32 = jnp.int32
+
+# Lane states.
+_HEADER, _SLEN, _SNLEN, _SCOPY = 0, 1, 2, 3
+_TBHDR, _TBCLLEN, _TBCODELEN = 4, 5, 6
+_DECODE, _DIST, _COPY, _DONE, _ERR = 7, 8, 9, 10, 11
+
+
+def _canonical_np(lens: np.ndarray, maxbits: int):
+    """count / first-code / symbol-offset arrays + (len,sym)-sorted
+    symbol list for a canonical Huffman code (puff's decode walk)."""
+    cnt = np.zeros(maxbits + 1, np.uint32)
+    for l in lens:
+        if l:
+            cnt[l] += 1
+    first = np.zeros(maxbits + 1, np.uint32)
+    off = np.zeros(maxbits + 1, np.uint32)
+    for l in range(2, maxbits + 1):
+        first[l] = (first[l - 1] + cnt[l - 1]) << 1
+        off[l] = off[l - 1] + cnt[l - 1]
+    symidx = np.array(
+        [s for l in range(1, maxbits + 1) for s in np.nonzero(lens == l)[0]],
+        np.int32,
+    )
+    return cnt, first, off, symidx
+
+
+_FLENS_L = _FIXED_LENS[:_NLIT]
+_FLENS_D = _FIXED_LENS[_NLIT:]
+_FCNT_L, _FFIRST_L, _FOFF_L, _FSYM_L = _canonical_np(_FLENS_L, 15)
+_FCNT_D, _FFIRST_D, _FOFF_D, _FSYM_D = _canonical_np(_FLENS_D, 15)
+_FSYM_L_PAD = np.zeros(_MAXLENS, np.int32)
+_FSYM_L_PAD[: len(_FSYM_L)] = _FSYM_L
+_FSYM_D_PAD = np.zeros(32, np.int32)
+_FSYM_D_PAD[: len(_FSYM_D)] = _FSYM_D
+
+
+def _riota(rows: int) -> jnp.ndarray:
+    return lax.broadcasted_iota(_I32, (rows, LANES), 0)
+
+
+def _gather(data, rows):
+    """One-hot row gather: data (R,128), rows (1,128) → (1,128).
+    The only per-lane dynamic-index read Mosaic compiles correctly
+    (PROBES.md 'Vector (VPU) facts'). Unsigned data is bitcast through
+    i32 — Mosaic has no unsigned reductions."""
+    r = data.shape[0]
+    unsigned = data.dtype == jnp.uint32
+    if unsigned:
+        data = lax.bitcast_convert_type(data, _I32)
+    g = jnp.sum(
+        jnp.where(_riota(r) == rows, data, jnp.zeros_like(data)),
+        axis=0,
+        keepdims=True,
+    )
+    return lax.bitcast_convert_type(g, _U32) if unsigned else g
+
+
+def _bcast_np(arr: np.ndarray) -> np.ndarray:
+    """(R,) constant broadcast to (R,128) — passed as a kernel input
+    (Pallas forbids captured array constants)."""
+    return np.broadcast_to(
+        np.asarray(arr, np.int32)[:, None], (len(arr), LANES)
+    ).copy()
+
+
+# Constant tables shipped to the kernel as one (R,128) input each.
+_CONST_TABLES = tuple(
+    _bcast_np(a)
+    for a in (_CLORDER, _FSYM_L_PAD, _FSYM_D_PAD, _LEXT, _LBASE, _DEXT,
+              _DBASE)
+)
+
+
+def _store_row(ref, rows, vals, mask):
+    """One-hot row store: ref[rows[l], l] = vals[l] where mask[l]."""
+    r = ref.shape[0]
+    cur = ref[...]
+    ref[...] = jnp.where((_riota(r) == rows) & mask, vals, cur)
+
+
+def _masked_rows(ref, new, mask):
+    """ref[:, l] = new[:, l] where mask[l] (full-column select-merge)."""
+    ref[...] = jnp.where(mask, new, ref[...])
+
+
+def _build_canonical(lens_ref, region_lo, region_hi, sym_bias, maxbits,
+                     cnt_ref, first_ref, off_ref, curs_ref, sym_ref, mask):
+    """Vectorized canonical table build for the lanes in ``mask``.
+
+    ``lens_ref`` is (R,128) code lengths; the alphabet for each lane is
+    rows [region_lo, region_hi) with symbol value row - sym_bias. Writes
+    the count/first/offset rows and the (len,sym)-sorted symbol table
+    via a counting sort of one-hot stores. Rows are read back through
+    the ref (dynamic uniform-row ref reads lower on Mosaic; dynamic
+    slices of loaded arrays do not).
+    """
+    lens = lens_ref[...]
+    r = lens.shape[0]
+    ri = _riota(r)
+    region = (ri >= region_lo) & (ri < region_hi)
+    cnts = []
+    for l in range(1, maxbits + 1):
+        c = jnp.sum(
+            jnp.where(region & (lens == l), jnp.ones_like(lens), 0),
+            axis=0, keepdims=True,
+        ).astype(_U32)
+        cnts.append(c)
+    first = jnp.zeros((1, LANES), _U32)
+    off = jnp.zeros((1, LANES), _U32)
+    zero = jnp.zeros((1, LANES), _U32)
+    first_rows, off_rows = [zero], [zero]
+    for l in range(1, maxbits + 1):
+        if l > 1:
+            first = (first + cnts[l - 2]) << 1
+            off = off + cnts[l - 2]
+        first_rows.append(first)
+        off_rows.append(off)
+    cnt_new = jnp.concatenate([zero] + cnts, axis=0)
+    first_new = jnp.concatenate(first_rows, axis=0)
+    off_new = jnp.concatenate(off_rows, axis=0)
+    _masked_rows(cnt_ref, cnt_new, mask)
+    _masked_rows(first_ref, first_new, mask)
+    _masked_rows(off_ref, off_new, mask)
+    _masked_rows(curs_ref, off_new, mask)
+
+    def body(p, _):
+        len_p = lens_ref[pl.ds(p, 1), :].astype(_I32)
+        in_reg = (
+            mask
+            & (p >= region_lo) & (p < region_hi)
+            & (len_p > 0)
+        )
+        rank = _gather(curs_ref[...].astype(_I32), len_p)
+        _store_row(
+            sym_ref, rank,
+            jnp.full((1, LANES), 0, _I32) + (p - sym_bias), in_reg,
+        )
+        _store_row(curs_ref, len_p, (rank + 1).astype(_U32), in_reg)
+        return 0
+
+    lax.fori_loop(0, r, body, 0)
+
+
+def _decode_canonical(bitbuf, maxbits, cnt, first, off,
+                      fcnt=None, ffirst=None, foff=None, fixed=None):
+    """Puff-style canonical walk, vectorized over lanes: returns
+    (symbol-table index, code length, found). ``cnt``/``first``/``off``
+    are (16,128) per-lane arrays; the optional f* numpy arrays are the
+    fixed-Huffman constants select-merged in for lanes with ``fixed``."""
+    code = jnp.zeros((1, LANES), _U32)
+    rem = bitbuf
+    idx = jnp.zeros((1, LANES), _I32)
+    nbits = jnp.zeros((1, LANES), _I32)
+    found = jnp.zeros((1, LANES), jnp.bool_)
+    for l in range(1, maxbits + 1):
+        bit = (rem & 1).astype(_U32)
+        rem = rem >> 1
+        code = (code << 1) | bit
+        c = cnt[l][None, :]
+        f = first[l][None, :]
+        o = off[l][None, :]
+        if fixed is not None:
+            c = jnp.where(fixed, _U32(int(fcnt[l])), c)
+            f = jnp.where(fixed, _U32(int(ffirst[l])), f)
+            o = jnp.where(fixed, _U32(int(foff[l])), o)
+        hit = (~found) & ((code - f) < c)
+        idx = jnp.where(hit, (o + (code - f)).astype(_I32), idx)
+        nbits = jnp.where(hit, l, nbits)
+        found = found | hit
+    return idx, nbits, found
+
+
+def _mask_bits(n):
+    """(1 << n) - 1 for per-lane n in [0, 32]. The clamp runs in i32 —
+    Mosaic cannot legalize unsigned min."""
+    n = n.astype(_I32)
+    full = n >= 32
+    safe = jnp.minimum(n, 31).astype(_U32)
+    return jnp.where(full, _U32(0xFFFFFFFF), (_U32(1) << safe) - 1)
+
+
+def _inflate_simd_kernel(
+    comp_ref, clen_ref,
+    clorder_ref, fsyml_ref, fsymd_ref, lext_ref, lbase_ref, dext_ref,
+    dbase_ref,
+    out_ref, meta_ref,
+    lens_ref, cl_lens_ref,
+    symlit_ref, symdist_ref, symcl_ref,
+    cntl_ref, firstl_ref, offl_ref, cursl_ref,
+    cntd_ref, firstd_ref, offd_ref, cursd_ref,
+    cntc_ref, firstc_ref, offc_ref, cursc_ref,
+    *, cw: int, ow: int, max_steps: int,
+):
+    zrow = jnp.zeros((1, LANES), _I32)
+    zrow_u = jnp.zeros((1, LANES), _U32)
+    out_ref[...] = jnp.zeros((ow, LANES), _U32)
+    for ref in (symlit_ref, symdist_ref, symcl_ref, lens_ref, cl_lens_ref):
+        ref[...] = jnp.zeros(ref.shape, ref.dtype)
+    for ref in (cntl_ref, firstl_ref, offl_ref, cursl_ref,
+                cntd_ref, firstd_ref, offd_ref, cursd_ref,
+                cntc_ref, firstc_ref, offc_ref, cursc_ref):
+        ref[...] = jnp.zeros(ref.shape, ref.dtype)
+
+    clen = clen_ref[...].astype(_I32)
+
+    def refill(bitbuf, bitcnt, inpos):
+        wrow = jnp.minimum(inpos >> 2, cw - 2)
+        w0 = _gather(comp_ref[...], wrow).astype(_U32)
+        w1 = _gather(comp_ref[...], wrow + 1).astype(_U32)
+        sh = ((inpos & 3) << 3).astype(_U32)
+        # (32 - sh) & 31 keeps the discarded sh==0 branch's shift defined
+        b = jnp.where(
+            sh == 0, w0, (w0 >> sh) | (w1 << ((_U32(32) - sh) & _U32(31))))
+        nbytes = (32 - bitcnt) >> 3
+        nbits = (nbytes << 3).astype(_U32)
+        add = jnp.where(
+            nbytes > 0,
+            (b & _mask_bits(nbits)) << jnp.minimum(bitcnt, 24).astype(_U32),
+            zrow_u,
+        )
+        return bitbuf | add, bitcnt + (nbytes << 3), inpos + nbytes
+
+    def superstep(carry):
+        (step, state, bitbuf, bitcnt, inpos, outpos, bfinal, fixed,
+         copy_len, copy_dist, hlit, hdist, hclen, tb_idx, tb_nread,
+         rep_val, rep_cnt, prev_len, status) = carry
+
+        live = (state != _DONE) & (state != _ERR)
+        bitbuf, bitcnt, inpos = refill(bitbuf, bitcnt, inpos)
+
+        new_state = state
+        new_status = status
+        emit = jnp.zeros((1, LANES), jnp.bool_)
+        emit_byte = zrow
+        used = zrow          # bits consumed in phase A
+
+        after_block = jnp.where(bfinal != 0, _DONE, _HEADER)
+
+        # ---- HEADER --------------------------------------------------
+        m = state == _HEADER
+        hdr = (bitbuf & 7).astype(_I32)
+        h_bfinal = hdr & 1
+        btype = (hdr >> 1) & 3
+        # stored: skip to byte boundary right here (3 + pad bits)
+        h_pad = (bitcnt - 3) & 7
+        h_used = jnp.where(btype == 0, 3 + h_pad, 3)
+        h_state = jnp.where(
+            btype == 0, _SLEN,
+            jnp.where(btype == 1, _DECODE,
+                      jnp.where(btype == 2, _TBHDR, _ERR)))
+        new_state = jnp.where(m, h_state, new_state)
+        new_status = jnp.where(m & (btype == 3), 1, new_status)
+        bfinal = jnp.where(m, h_bfinal, bfinal)
+        fixed = jnp.where(m, (btype == 1).astype(_I32), fixed)
+        used = jnp.where(m, h_used, used)
+        # zero the code-length buffers for lanes starting a dyn block
+        mdyn = m & (btype == 2)
+        _masked_rows(lens_ref, jnp.zeros(lens_ref.shape, _I32), mdyn)
+        _masked_rows(cl_lens_ref, jnp.zeros(cl_lens_ref.shape, _I32), mdyn)
+
+        # ---- STORED len/nlen/copy -----------------------------------
+        m = state == _SLEN
+        s_len = (bitbuf & 0xFFFF).astype(_I32)
+        copy_len = jnp.where(m, s_len, copy_len)
+        used = jnp.where(m, 16, used)
+        new_state = jnp.where(m, _SNLEN, new_state)
+
+        m = state == _SNLEN
+        s_nlen = (bitbuf & 0xFFFF).astype(_I32)
+        bad = (s_nlen ^ 0xFFFF) != copy_len
+        used = jnp.where(m, 16, used)
+        new_state = jnp.where(
+            m,
+            jnp.where(bad, _ERR,
+                      jnp.where(copy_len > 0, _SCOPY, after_block)),
+            new_state)
+        new_status = jnp.where(m & bad, 2, new_status)
+
+        m = state == _SCOPY
+        sc_byte = (bitbuf & 0xFF).astype(_I32)
+        used = jnp.where(m, 8, used)
+        emit = emit | m
+        emit_byte = jnp.where(m, sc_byte, emit_byte)
+        copy_len = jnp.where(m, copy_len - 1, copy_len)
+        new_state = jnp.where(
+            m & (copy_len == 0), after_block, new_state)
+
+        # ---- TB_HDR: HLIT/HDIST/HCLEN -------------------------------
+        m = state == _TBHDR
+        v = bitbuf.astype(_U32)
+        t_hlit = ((v & 31) + 257).astype(_I32)
+        t_hdist = (((v >> 5) & 31) + 1).astype(_I32)
+        t_hclen = (((v >> 10) & 15) + 4).astype(_I32)
+        hlit = jnp.where(m, t_hlit, hlit)
+        hdist = jnp.where(m, t_hdist, hdist)
+        hclen = jnp.where(m, t_hclen, hclen)
+        tb_idx = jnp.where(m, 0, tb_idx)
+        tb_nread = jnp.where(m, 0, tb_nread)
+        used = jnp.where(m, 14, used)
+        new_state = jnp.where(m, _TBCLLEN, new_state)
+
+        # ---- TB_CLLEN: one 3-bit CL code length per superstep --------
+        m = state == _TBCLLEN
+        cl_v = (bitbuf & 7).astype(_I32)
+        ord_pos = _gather(clorder_ref[...], tb_idx)
+        _store_row(cl_lens_ref, ord_pos, cl_v, m)
+        tb_idx = jnp.where(m, tb_idx + 1, tb_idx)
+        used = jnp.where(m, 3, used)
+        cl_done = m & (tb_idx >= hclen)
+        new_state = jnp.where(cl_done, _TBCODELEN, new_state)
+
+        def build_cl():
+            _build_canonical(
+                cl_lens_ref, zrow, zrow + 19, 0, 7,
+                cntc_ref, firstc_ref, offc_ref, cursc_ref, symcl_ref,
+                cl_done)
+
+        pl.when(jnp.any(cl_done))(build_cl)
+
+        # ---- TB_CODELEN: decode one CL symbol or emit one repeat -----
+        m = state == _TBCODELEN
+        total = hlit + hdist
+        in_rep = m & (rep_cnt > 0)
+        # repeat write
+        _store_row(lens_ref, tb_nread, rep_val, in_rep & (tb_nread < total))
+        new_status = jnp.where(in_rep & (tb_nread >= total), 7, new_status)
+        new_state = jnp.where(in_rep & (tb_nread >= total), _ERR, new_state)
+        tb_nread = jnp.where(in_rep, tb_nread + 1, tb_nread)
+        rep_cnt = jnp.where(in_rep, rep_cnt - 1, rep_cnt)
+        prev_len = jnp.where(in_rep, rep_val, prev_len)
+
+        mdec = m & ~in_rep
+        cidx, cbits, cfound = _decode_canonical(
+            bitbuf, 7, cntc_ref[...], firstc_ref[...], offc_ref[...])
+        csym = _gather(symcl_ref[...], cidx)
+        bad = mdec & ~cfound
+        new_status = jnp.where(bad, 3, new_status)
+        new_state = jnp.where(bad, _ERR, new_state)
+        # literal length 0..15
+        ml = mdec & cfound & (csym <= 15)
+        _store_row(lens_ref, tb_nread, csym, ml & (tb_nread < total))
+        new_status = jnp.where(ml & (tb_nread >= total), 7, new_status)
+        new_state = jnp.where(ml & (tb_nread >= total), _ERR, new_state)
+        prev_len = jnp.where(ml, csym, prev_len)
+        # repeats: 16 = prev x 3+2bits, 17 = 0 x 3+3bits, 18 = 0 x 11+7bits
+        rext = bitbuf >> cbits.astype(_U32)
+        m16 = mdec & cfound & (csym == 16)
+        m17 = mdec & cfound & (csym == 17)
+        m18 = mdec & cfound & (csym == 18)
+        new_status = jnp.where(m16 & (tb_nread == 0), 7, new_status)
+        new_state = jnp.where(m16 & (tb_nread == 0), _ERR, new_state)
+        rep_cnt = jnp.where(m16, 3 + (rext & 3).astype(_I32), rep_cnt)
+        rep_cnt = jnp.where(m17, 3 + (rext & 7).astype(_I32), rep_cnt)
+        rep_cnt = jnp.where(m18, 11 + (rext & 127).astype(_I32), rep_cnt)
+        rep_val = jnp.where(m16, prev_len, jnp.where(m17 | m18, 0, rep_val))
+        cl_extra = jnp.where(m16, 2, jnp.where(m17, 3, jnp.where(m18, 7, 0)))
+        tb_nread = jnp.where(ml, tb_nread + 1, tb_nread)
+        used = jnp.where(mdec, cbits + cl_extra, used)
+
+        # finalize when all code lengths are in
+        fin = (m & (tb_nread >= total)
+               & (new_state != _ERR)
+               & ~(in_rep & (rep_cnt > 0)))
+
+        def build_main():
+            _build_canonical(
+                lens_ref, zrow, hlit, 0, 15,
+                cntl_ref, firstl_ref, offl_ref, cursl_ref, symlit_ref, fin)
+            _build_canonical(
+                lens_ref, hlit, hlit + hdist, hlit, 15,
+                cntd_ref, firstd_ref, offd_ref, cursd_ref, symdist_ref, fin)
+
+        pl.when(jnp.any(fin))(build_main)
+        new_state = jnp.where(fin, _DECODE, new_state)
+        fixed = jnp.where(fin, 0, fixed)
+
+        # ---- DECODE: one literal/length symbol -----------------------
+        m = state == _DECODE
+        fixed_b = fixed != 0
+        didx, dbits, dfound = _decode_canonical(
+            bitbuf, 15, cntl_ref[...], firstl_ref[...], offl_ref[...],
+            _FCNT_L, _FFIRST_L, _FOFF_L, fixed_b)
+        symdata = jnp.where(fixed_b, fsyml_ref[...], symlit_ref[...])
+        sym = _gather(symdata, didx)
+        bad = m & ~dfound
+        new_status = jnp.where(bad, 3, new_status)
+        new_state = jnp.where(bad, _ERR, new_state)
+        mok = m & dfound
+        # literal
+        mlit = mok & (sym < 256)
+        emit = emit | mlit
+        emit_byte = jnp.where(mlit, sym, emit_byte)
+        # end of block
+        meob = mok & (sym == 256)
+        new_state = jnp.where(meob, after_block, new_state)
+        # length code
+        mlen = mok & (sym > 256)
+        li = jnp.clip(sym - 257, 0, 28)
+        bad_len = mlen & (sym - 257 > 28)
+        new_status = jnp.where(bad_len, 3, new_status)
+        new_state = jnp.where(bad_len, _ERR, new_state)
+        lext = _gather(lext_ref[...], li)
+        lbase = _gather(lbase_ref[...], li)
+        lex_v = ((bitbuf >> dbits.astype(_U32)) &
+                 _mask_bits(lext)).astype(_I32)
+        copy_len = jnp.where(mlen, lbase + lex_v, copy_len)
+        new_state = jnp.where(mlen & ~bad_len, _DIST, new_state)
+        used = jnp.where(m, dbits + jnp.where(mlen, lext, 0), used)
+
+        # ---- consume phase-A bits, refill for phase B ---------------
+        usedu = jnp.where(live, used, zrow).astype(_U32)
+        bitbuf = bitbuf >> usedu
+        bitcnt = bitcnt - used * jnp.where(live, 1, 0)
+        bitbuf, bitcnt, inpos = refill(bitbuf, bitcnt, inpos)
+
+        # ---- DIST (phase B): distance code, refill, then extra bits.
+        # A 15-bit code + 13 extra bits needs 28 valid bits but refill
+        # only guarantees 25, so the code is consumed and the buffer
+        # refilled BEFORE the extra bits are read.
+        m = (state == _DIST) & live
+        xidx, xbits, xfound = _decode_canonical(
+            bitbuf, 15, cntd_ref[...], firstd_ref[...], offd_ref[...],
+            _FCNT_D, _FFIRST_D, _FOFF_D, fixed_b)
+        symdata_d = jnp.where(fixed_b, fsymd_ref[...], symdist_ref[...])
+        dsym = _gather(symdata_d, xidx)
+        bad = m & (~xfound | (dsym > 29))
+        new_status = jnp.where(bad, 3, new_status)
+        new_state = jnp.where(bad, _ERR, new_state)
+        mok = m & ~bad
+        used_code = jnp.where(m, xbits, zrow)
+        bitbuf = bitbuf >> used_code.astype(_U32)
+        bitcnt = bitcnt - used_code
+        bitbuf, bitcnt, inpos = refill(bitbuf, bitcnt, inpos)
+        dsym_c = jnp.clip(dsym, 0, 29)
+        dext = _gather(dext_ref[...], dsym_c)
+        dbase = _gather(dbase_ref[...], dsym_c)
+        dex_v = (bitbuf & _mask_bits(dext)).astype(_I32)
+        dist = dbase + dex_v
+        bad_d = mok & ((dist > outpos) | (dist > 32768))
+        new_status = jnp.where(bad_d, 4, new_status)
+        new_state = jnp.where(bad_d, _ERR, new_state)
+        copy_dist = jnp.where(mok, dist, copy_dist)
+        new_state = jnp.where(mok & ~bad_d, _COPY, new_state)
+        used_b = jnp.where(mok, dext, zrow)
+        bitbuf = bitbuf >> used_b.astype(_U32)
+        bitcnt = bitcnt - used_b
+
+        # ---- COPY: one history byte per superstep --------------------
+        m = (state == _COPY) & live
+
+        def hist_byte():
+            src = outpos - copy_dist
+            word = _gather(out_ref[...], jnp.minimum(src >> 2, ow - 1))
+            sh = ((src & 3) << 3).astype(_U32)
+            return ((word >> sh) & 0xFF).astype(_I32)
+
+        cbyte = lax.cond(
+            jnp.any(m), hist_byte, lambda: zrow)
+        emit = emit | m
+        emit_byte = jnp.where(m, cbyte, emit_byte)
+        copy_len = jnp.where(m, copy_len - 1, copy_len)
+        new_state = jnp.where(m & (copy_len == 0), _DECODE, new_state)
+
+        # ---- emit merge ---------------------------------------------
+        emit = emit & live & (new_state != _ERR)
+        over = emit & (outpos >= ow * 4)
+        new_status = jnp.where(over, 5, new_status)
+        new_state = jnp.where(over, _ERR, new_state)
+        emit = emit & ~over
+        wrow = outpos >> 2
+        wsh = ((outpos & 3) << 3).astype(_U32)
+        cur = out_ref[...]
+        out_ref[...] = jnp.where(
+            (_riota(ow) == wrow) & emit,
+            cur | (emit_byte.astype(_U32) << wsh),
+            cur)
+        outpos = outpos + jnp.where(emit, 1, 0)
+
+        # ---- input-overrun guard ------------------------------------
+        consumed = (inpos << 3) - bitcnt
+        overrun = live & (consumed > ((clen + 8) << 3))
+        new_status = jnp.where(overrun, 6, new_status)
+        new_state = jnp.where(overrun, _ERR, new_state)
+
+        return (step + 1, new_state, bitbuf, bitcnt, inpos, outpos,
+                bfinal, fixed, copy_len, copy_dist, hlit, hdist, hclen,
+                tb_idx, tb_nread, rep_val, rep_cnt, prev_len, new_status)
+
+    def cond(carry):
+        step, state = carry[0], carry[1]
+        return (step < max_steps) & jnp.any(
+            (state != _DONE) & (state != _ERR))
+
+    init_state = jnp.where(clen > 0, _HEADER, _DONE)
+    init = (
+        jnp.int32(0), init_state, zrow_u, zrow, zrow, zrow,
+        zrow, zrow, zrow, zrow,
+        zrow, zrow, zrow, zrow, zrow, zrow, zrow, zrow, zrow,
+    )
+    final = lax.while_loop(cond, superstep, init)
+    step, state, _bb, _bc, _ip, outpos = final[:6]
+    status = final[18]
+    # lanes still live at the step cap ran away
+    status = jnp.where(
+        (state != _DONE) & (state != _ERR), 6, status)
+    meta_ref[...] = jnp.concatenate(
+        [outpos, status, jnp.broadcast_to(step[None, None], (1, LANES)),
+         jnp.zeros((1, LANES), _I32)], axis=0)
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled(cw: int, ow: int, interpret: bool):
+    max_steps = 2 * ow * 4 + 8192
+    kernel = functools.partial(
+        _inflate_simd_kernel, cw=cw, ow=ow, max_steps=max_steps)
+    t16 = pltpu.VMEM((16, LANES), _U32)
+    t8 = pltpu.VMEM((8, LANES), _U32)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((ow, LANES), _U32),
+            jax.ShapeDtypeStruct((4, LANES), _I32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (2 + len(_CONST_TABLES)),
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((_MAXLENS, LANES), _I32),   # lens
+            pltpu.VMEM((19, LANES), _I32),         # cl_lens
+            pltpu.VMEM((_MAXLENS, LANES), _I32),   # symlit
+            pltpu.VMEM((32, LANES), _I32),         # symdist
+            pltpu.VMEM((19, LANES), _I32),         # symcl
+            t16, t16, t16, t16,                    # lit cnt/first/off/curs
+            t16, t16, t16, t16,                    # dist
+            t8, t8, t8, t8,                        # cl
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def _bucket(n: int, lo: int = 64) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def inflate_payloads_simd(
+    payloads: Sequence[bytes],
+    usizes: Optional[Sequence[int]] = None,
+    interpret: Optional[bool] = None,
+) -> List[bytes]:
+    """Inflate raw-DEFLATE payloads on the 128-lane SIMD kernel.
+
+    Returns the decompressed bytes per payload. Lanes that fail in-kernel
+    (nonzero status) are re-inflated with host zlib — corruption is the
+    host's problem to report, with the same exceptions as the host path.
+    """
+    import zlib
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not payloads:
+        return []
+    # VMEM budget (~16 MB/core): comp (8192,128) u32 = 4 MB + out
+    # (16384,128) u32 = 8 MB + ~0.5 MB tables. Payloads too big for the
+    # comp cap (possible only for near-incompressible data — BAM BGZF
+    # payloads compress ~3-4x) go to host zlib.
+    max_csize = 8192 * 4 - 16
+    big = [i for i, p in enumerate(payloads) if len(p) > max_csize]
+    if big:
+        import zlib as _z
+
+        bigset = set(big)
+        small = [p for i, p in enumerate(payloads) if i not in bigset]
+        small_us = (None if usizes is None else
+                    [u for i, u in enumerate(usizes) if i not in bigset])
+        small_out = iter(
+            inflate_payloads_simd(small, small_us, interpret=interpret))
+        return [
+            _z.decompress(p, wbits=-15) if i in bigset else next(small_out)
+            for i, p in enumerate(payloads)
+        ]
+    max_c = max(len(p) for p in payloads)
+    if usizes is not None:
+        max_u = max(usizes) if len(usizes) else 0
+    else:
+        max_u = 65536
+    cw = _bucket((max_c + 8) // 4 + 2)
+    ow = min(_bucket(max(1, (max_u + 3) // 4)), 16384)
+    fn = _compiled(cw, ow, interpret)
+
+    out: List[bytes] = []
+    for lo in range(0, len(payloads), LANES):
+        chunk = payloads[lo: lo + LANES]
+        comp = np.zeros((cw, LANES), dtype="<u4")
+        clen = np.zeros((1, LANES), dtype=np.int32)
+        for i, p in enumerate(chunk):
+            clen[0, i] = len(p)
+            pad = (-len(p)) % 4
+            w = np.frombuffer(p + b"\x00" * pad, dtype="<u4")
+            comp[: len(w), i] = w
+        words, meta = fn(jnp.asarray(comp.view(np.uint32)),
+                         jnp.asarray(clen),
+                         *(jnp.asarray(t) for t in _CONST_TABLES))
+        words = np.asarray(words)
+        meta = np.asarray(meta)
+        for i, p in enumerate(chunk):
+            n, status = int(meta[0, i]), int(meta[1, i])
+            expect = None if usizes is None else int(usizes[lo + i])
+            if status != 0 or (expect is not None and n != expect):
+                host = zlib.decompress(p, wbits=-15)
+                if expect is not None and len(host) != expect:
+                    # genuine ISIZE mismatch (error 8) — the host path
+                    # raises here too; swallowing it would break the
+                    # cumulative-usize slicing in bam/source.py
+                    raise ValueError(
+                        f"device inflate failed: error 8 "
+                        f"(ISIZE {expect} != {len(host)})")
+                out.append(host)
+                continue
+            out.append(np.ascontiguousarray(words[:, i]).tobytes()[:n])
+    return out

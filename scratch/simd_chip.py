@@ -1,0 +1,57 @@
+"""Milestone (c) probe: compile + run the SIMD inflate kernel on the
+real TPU chip (interpret=False), correctness vs zlib, then timing."""
+import sys
+import time
+import zlib
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def deflate(data, level=6, strategy=zlib.Z_DEFAULT_STRATEGY):
+    c = zlib.compressobj(level, zlib.DEFLATED, -15, 8, strategy)
+    return c.compress(data) + c.flush()
+
+
+def main():
+    import jax
+    print("backend:", jax.default_backend(), jax.devices())
+    from disq_tpu.ops.inflate_simd import inflate_payloads_simd
+
+    rng = np.random.default_rng(0)
+    sizes = sys.argv[1:] or ["2000"]
+    n = int(sizes[0])
+    nlanes = int(sizes[1]) if len(sizes) > 1 else 128
+
+    words = [b"the", b"quick", b"brown", b"fox", b"jumps", b"!", b"\n"]
+    raws = []
+    for i in range(nlanes):
+        t = b" ".join(words[j % 7] for j in rng.integers(0, 7, n // 4))
+        raws.append(t[:n] + bytes(rng.integers(0, 256, max(0, n - len(t)), dtype=np.uint8)))
+    payloads = [deflate(r) for r in raws]
+    usizes = [len(r) for r in raws]
+
+    t0 = time.perf_counter()
+    got = inflate_payloads_simd(payloads, usizes=usizes, interpret=False)
+    t1 = time.perf_counter()
+    ok = all(g == r for g, r in zip(got, raws))
+    print(f"compile+run1: {t1-t0:.1f}s correct={ok}")
+    if not ok:
+        for i, (g, r) in enumerate(zip(got, raws)):
+            if g != r:
+                d = next((j for j in range(min(len(g), len(r))) if g[j] != r[j]), "len")
+                print(f"  lane {i}: {len(g)} vs {len(r)}, first diff {d}")
+                break
+        return
+    # timed reps
+    for _ in range(3):
+        t0 = time.perf_counter()
+        got = inflate_payloads_simd(payloads, usizes=usizes, interpret=False)
+        t1 = time.perf_counter()
+        tot = sum(usizes)
+        print(f"run: {t1-t0:.3f}s  {tot/(t1-t0)/1e6:.2f} MB/s ({tot/1e6:.2f} MB out)")
+
+
+if __name__ == "__main__":
+    main()

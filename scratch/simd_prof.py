@@ -1,0 +1,122 @@
+"""Per-superstep cost attribution for the SIMD inflate kernel.
+
+Times a while_loop of N supersteps with the body built up in stages:
+ A: refill-shaped gathers only (6 one-hot over (512,128)) + carry churn
+ B: A + two unrolled 15-step canonical decode walks (the op-count term)
+ C: B + 3 x (jnp.any reduction + pl.when/cond with tiny body)
+ D: C + emit RMW sweep + history gather over (OW,128)
+Slope (t(N2)-t(N1))/(N2-N1) isolates per-superstep cost from the RPC
+floor, per PROBES.md measurement caveats.
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def riota(r):
+    return lax.broadcasted_iota(I32, (r, LANES), 0)
+
+
+def gather(data, rows):
+    return jnp.sum(jnp.where(riota(data.shape[0]) == rows,
+                             lax.bitcast_convert_type(data, I32), 0),
+                   axis=0, keepdims=True)
+
+
+def make_kernel(n_steps, stage, ow):
+    def kernel(comp_ref, out_ref, meta_ref):
+        out_ref[...] = jnp.zeros((ow, LANES), I32)
+
+        def body(carry):
+            step, a, b, c = carry
+            # A: 6 refill-shaped gathers
+            acc = a
+            for k in range(6):
+                acc = acc + gather(comp_ref[...], (acc + k) & 511)
+            if stage >= 2:
+                # B: 2x unrolled 15-iteration canonical walks
+                code = b.astype(U32)
+                rem = acc.astype(U32)
+                found = jnp.zeros((1, LANES), jnp.bool_)
+                nb = jnp.zeros((1, LANES), I32)
+                for walk in range(2):
+                    for l in range(1, 16):
+                        bit = (rem & 1).astype(U32)
+                        rem = rem >> 1
+                        code = (code << 1) | bit
+                        hit = (~found) & ((code - U32(l)) < U32(3))
+                        nb = jnp.where(hit, l, nb)
+                        found = found | hit
+                acc = acc + nb + lax.bitcast_convert_type(code, I32)
+            if stage >= 3:
+                # C: 3 any-reductions with gated tiny bodies
+                for k in range(3):
+                    def tiny():
+                        meta_ref[0:1, :] = meta_ref[0:1, :] + 1
+                    pl.when(jnp.any(acc == (-7 - k)))(tiny)
+            if stage >= 4:
+                # D: history gather + emit RMW over (ow, LANES)
+                src = (acc & 0x7FFF) % ow
+                word = gather(out_ref[...], src)
+                byte = (word >> ((acc & 3) << 3)) & 0xFF
+                cur = out_ref[...]
+                out_ref[...] = jnp.where(
+                    (riota(ow) == ((acc + step) % ow)),
+                    cur | byte, cur)
+            return step + 1, acc, b + 1, c
+
+        def cond(carry):
+            return carry[0] < n_steps
+
+        final = lax.while_loop(cond, body, (
+            jnp.int32(0), jnp.zeros((1, LANES), I32),
+            jnp.zeros((1, LANES), I32), jnp.zeros((1, LANES), I32)))
+        meta_ref[...] = jnp.broadcast_to(final[1], (1, LANES)) + final[0]
+
+    return kernel
+
+
+def run(n_steps, stage, ow=2048):
+    comp = np.zeros((512, LANES), np.int32)
+    call = pl.pallas_call(
+        make_kernel(n_steps, stage, ow),
+        out_shape=(jax.ShapeDtypeStruct((ow, LANES), I32),
+                   jax.ShapeDtypeStruct((1, LANES), I32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+    )
+    fn = jax.jit(call)
+    _ = np.asarray(fn(comp)[1])  # compile+warm
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _ = np.asarray(fn(comp)[1])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    for stage in (1, 2, 3, 4):
+        t1 = run(20000, stage)
+        t2 = run(100000, stage)
+        slope = (t2 - t1) / 80000
+        print(f"stage {stage}: t(2k)={t1:.3f}s t(10k)={t2:.3f}s "
+              f"slope={slope*1e6:.2f} us/superstep")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Slope-measure the real SIMD inflate kernel: same cw/ow buckets, two
+stream lengths; per-superstep cost = (tB - tA) / (ssB - ssA)."""
+import sys
+import time
+import zlib
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def deflate(data, level=6):
+    c = zlib.compressobj(level, zlib.DEFLATED, -15, 8)
+    return c.compress(data) + c.flush()
+
+
+def make(n, rng):
+    words = [b"the", b"quick", b"brown", b"fox", b"jumps", b"!", b"\n"]
+    t = b" ".join(words[j % 7] for j in rng.integers(0, 7, n // 4))
+    return (t + b"x" * n)[:n]
+
+
+def run(fn, payloads, usizes, reps=5):
+    from disq_tpu.ops.inflate_simd import inflate_payloads_simd
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = inflate_payloads_simd(payloads, usizes=None, interpret=False)
+        best = min(best, time.perf_counter() - t0)
+    return best, got
+
+
+def main():
+    rng = np.random.default_rng(0)
+    pad_to = 7200
+    sizes = (6000, 26000)
+    results = {}
+    for n in sizes:
+        raws = [make(n, rng) for _ in range(128)]
+        pays = [deflate(r) for r in raws]
+        maxp = max(len(p) for p in pays)
+        assert maxp <= pad_to, maxp
+        pays = [p + b"\x00" * (pad_to - len(p)) for p in pays]
+        t, got = run(None, pays, None)
+        ok = all(g == r for g, r in zip(got, raws))
+        results[n] = t
+        print(f"n={n}: best={t:.3f}s correct={ok}")
+    ss = {n: int(n * 1.35) for n in sizes}
+    slope = (results[26000] - results[6000]) / (ss[26000] - ss[6000])
+    tput = 128 * (sizes[1] - sizes[0]) / (results[26000] - results[6000]) / 1e6
+    print(f"slope ~= {slope*1e6:.2f} us/superstep; marginal {tput:.1f} MB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -134,8 +134,10 @@ def test_isize_mismatch_detected():
 
 
 def test_end_to_end_bam_read_via_device_inflate(tmp_path, monkeypatch):
-    """Full ReadsStorage.read with DISQ_TPU_DEVICE_INFLATE=1: the Pallas
-    kernel decodes every BGZF block on the read path."""
+    """Full ReadsStorage.read with DISQ_TPU_DEVICE_INFLATE=legacy: this
+    round-1 Pallas kernel decodes every BGZF block on the read path.
+    (The =1 default routes to the SIMD kernel — covered with
+    interpret-feasible block sizes in test_inflate_simd.py.)"""
     from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
     from disq_tpu.api import ReadsStorage
 
@@ -143,7 +145,7 @@ def test_end_to_end_bam_read_via_device_inflate(tmp_path, monkeypatch):
     src = tmp_path / "in.bam"
     src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
     host = ReadsStorage.make_default().read(str(src))
-    monkeypatch.setenv("DISQ_TPU_DEVICE_INFLATE", "1")
+    monkeypatch.setenv("DISQ_TPU_DEVICE_INFLATE", "legacy")
     dev = ReadsStorage.make_default().read(str(src))
     assert dev.count() == host.count() == 1500
     np.testing.assert_array_equal(dev.reads.pos, host.reads.pos)
@@ -157,6 +159,7 @@ def test_device_inflate_crc_mismatch(tmp_path, monkeypatch):
     from disq_tpu.bgzf.guesser import find_block_table
     from disq_tpu.fsw import MemoryFileSystemWrapper
 
+    monkeypatch.setenv("DISQ_TPU_DEVICE_INFLATE", "legacy")
     data = bytearray(make_bam_bytes(DEFAULT_REFS, synth_records(100, seed=9)))
     fs = MemoryFileSystemWrapper()
     fs.write_all("mem://x.bam", bytes(data))
