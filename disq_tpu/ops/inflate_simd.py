@@ -66,6 +66,8 @@ from disq_tpu.ops.inflate import (
 
 LANES = 128
 _MAXLENS = 320          # 288 lit/len + 32 dist code lengths
+RING_W = 1024           # history ring: last 4 KiB per lane, word rows
+RING_SAFE = 4096 - 8    # max distance served by the ring
 _U32 = jnp.uint32
 _I32 = jnp.int32
 
@@ -142,10 +144,13 @@ _CONST_TABLES = tuple(
 
 
 def _store_row(ref, rows, vals, mask):
-    """One-hot row store: ref[rows[l], l] = vals[l] where mask[l]."""
+    """One-hot row store: ref[rows[l], l] = vals[l] where mask[l].
+    The mask is folded into the row index (row -1 matches nothing) so
+    the predicate keeps the pure ``iota == rows`` one-hot shape."""
     r = ref.shape[0]
+    folded = jnp.where(mask, rows, -1)
     cur = ref[...]
-    ref[...] = jnp.where((_riota(r) == rows) & mask, vals, cur)
+    ref[...] = jnp.where(_riota(r) == folded, vals, cur)
 
 
 def _masked_rows(ref, new, mask):
@@ -259,6 +264,7 @@ def _inflate_simd_kernel(
     cntl_ref, firstl_ref, offl_ref, cursl_ref,
     cntd_ref, firstd_ref, offd_ref, cursd_ref,
     cntc_ref, firstc_ref, offc_ref, cursc_ref,
+    ring_ref,
     *, cw: int, ow: int, max_steps: int,
 ):
     zrow = jnp.zeros((1, LANES), _I32)
@@ -268,40 +274,61 @@ def _inflate_simd_kernel(
         ref[...] = jnp.zeros(ref.shape, ref.dtype)
     for ref in (cntl_ref, firstl_ref, offl_ref, cursl_ref,
                 cntd_ref, firstd_ref, offd_ref, cursd_ref,
-                cntc_ref, firstc_ref, offc_ref, cursc_ref):
+                cntc_ref, firstc_ref, offc_ref, cursc_ref, ring_ref):
         ref[...] = jnp.zeros(ref.shape, ref.dtype)
 
     clen = clen_ref[...].astype(_I32)
 
-    def refill(bitbuf, bitcnt, inpos):
-        wrow = jnp.minimum(inpos >> 2, cw - 2)
-        w0 = _gather(comp_ref[...], wrow).astype(_U32)
-        w1 = _gather(comp_ref[...], wrow + 1).astype(_U32)
-        sh = ((inpos & 3) << 3).astype(_U32)
-        # (32 - sh) & 31 keeps the discarded sh==0 branch's shift defined
-        b = jnp.where(
-            sh == 0, w0, (w0 >> sh) | (w1 << ((_U32(32) - sh) & _U32(31))))
-        nbytes = (32 - bitcnt) >> 3
-        nbits = (nbytes << 3).astype(_U32)
-        add = jnp.where(
-            nbytes > 0,
-            (b & _mask_bits(nbits)) << jnp.minimum(bitcnt, 24).astype(_U32),
-            zrow_u,
-        )
-        return bitbuf | add, bitcnt + (nbytes << 3), inpos + nbytes
+    # 64-bit bit buffer as a (lo, hi) u32 pair + total valid-bit count.
+    # One *word-aligned* single gather per refill site (the one-hot fast
+    # path); two refill sites per superstep keep every phase's peek
+    # within the low word: pre-phase-A cnt >= 33, phase A consumes <= 32
+    # (a word-aligned 4-byte stored copy; Huffman paths <= 20),
+    # pre-phase-B refill restores >= 33, dist code <= 15 leaves >= 18
+    # >= 13 extra bits. No unaligned double-gather assembly.
+    def refill64(lo, hi, cnt, in_w):
+        w = _gather(comp_ref[...], jnp.minimum(in_w, cw - 1)).astype(_U32)
+        do = cnt <= 32
+        cu = jnp.minimum(cnt, 31).astype(_U32)
+        lo = jnp.where(do & (cnt < 32), lo | (w << cu), lo)
+        hi_add = jnp.where(
+            cnt == 32, w,
+            jnp.where(cnt > 0, w >> ((_U32(32) - cu) & _U32(31)), zrow_u))
+        hi = jnp.where(do, hi | hi_add, hi)
+        cnt = cnt + jnp.where(do, 32, 0)
+        in_w = in_w + jnp.where(do, 1, 0)
+        return lo, hi, cnt, in_w
+
+    def consume64(lo, hi, cnt, n):
+        """Drop n (0..32, per-lane) low bits from the pair. n == 32
+        (a word-aligned 4-byte stored copy) is handled explicitly —
+        u32 shift-by-32 is implementation-defined on XLA backends."""
+        nu = jnp.minimum(n, 31).astype(_U32)
+        n0 = n == 0
+        full = n >= 32
+        lo2 = (lo >> nu) | (hi << ((_U32(32) - nu) & _U32(31)))
+        lo2 = jnp.where(full, hi, lo2)
+        hi2 = jnp.where(full, zrow_u, hi >> nu)
+        return (jnp.where(n0, lo, lo2), jnp.where(n0, hi, hi2), cnt - n)
 
     def superstep(carry):
-        (step, state, bitbuf, bitcnt, inpos, outpos, bfinal, fixed,
+        (step, state, lo, hi, cnt, in_w, outpos, bfinal, fixed,
          copy_len, copy_dist, hlit, hdist, hclen, tb_idx, tb_nread,
          rep_val, rep_cnt, prev_len, status) = carry
 
         live = (state != _DONE) & (state != _ERR)
-        bitbuf, bitcnt, inpos = refill(bitbuf, bitcnt, inpos)
+        lo, hi, cnt, in_w = refill64(lo, hi, cnt, in_w)
+        bitbuf = lo
 
         new_state = state
         new_status = status
-        emit = jnp.zeros((1, LANES), jnp.bool_)
-        emit_byte = zrow
+        # emit: up to 4 bytes per lane per superstep, clipped at the
+        # output word boundary so the big-out RMW is a single one-hot
+        # pass. packed = LE bytes, emit_k = byte count (0..4).
+        emit_k = zrow
+        packed = zrow_u
+        off = outpos & 3
+        kmax = 4 - off       # bytes until the word boundary
         used = zrow          # bits consumed in phase A
 
         after_block = jnp.where(bfinal != 0, _DONE, _HEADER)
@@ -312,7 +339,7 @@ def _inflate_simd_kernel(
         h_bfinal = hdr & 1
         btype = (hdr >> 1) & 3
         # stored: skip to byte boundary right here (3 + pad bits)
-        h_pad = (bitcnt - 3) & 7
+        h_pad = (cnt - 3) & 7
         h_used = jnp.where(btype == 0, 3 + h_pad, 3)
         h_state = jnp.where(
             btype == 0, _SLEN,
@@ -347,11 +374,11 @@ def _inflate_simd_kernel(
         new_status = jnp.where(m & bad, 2, new_status)
 
         m = state == _SCOPY
-        sc_byte = (bitbuf & 0xFF).astype(_I32)
-        used = jnp.where(m, 8, used)
-        emit = emit | m
-        emit_byte = jnp.where(m, sc_byte, emit_byte)
-        copy_len = jnp.where(m, copy_len - 1, copy_len)
+        sk = jnp.minimum(kmax, copy_len)
+        used = jnp.where(m, sk << 3, used)
+        emit_k = jnp.where(m, sk, emit_k)
+        packed = jnp.where(m, bitbuf, packed)
+        copy_len = jnp.where(m, copy_len - sk, copy_len)
         new_state = jnp.where(
             m & (copy_len == 0), after_block, new_state)
 
@@ -458,8 +485,8 @@ def _inflate_simd_kernel(
         mok = m & dfound
         # literal
         mlit = mok & (sym < 256)
-        emit = emit | mlit
-        emit_byte = jnp.where(mlit, sym, emit_byte)
+        emit_k = jnp.where(mlit, 1, emit_k)
+        packed = jnp.where(mlit, sym.astype(_U32), packed)
         # end of block
         meob = mok & (sym == 256)
         new_state = jnp.where(meob, after_block, new_state)
@@ -478,10 +505,9 @@ def _inflate_simd_kernel(
         used = jnp.where(m, dbits + jnp.where(mlen, lext, 0), used)
 
         # ---- consume phase-A bits, refill for phase B ---------------
-        usedu = jnp.where(live, used, zrow).astype(_U32)
-        bitbuf = bitbuf >> usedu
-        bitcnt = bitcnt - used * jnp.where(live, 1, 0)
-        bitbuf, bitcnt, inpos = refill(bitbuf, bitcnt, inpos)
+        lo, hi, cnt = consume64(lo, hi, cnt, jnp.where(live, used, zrow))
+        lo, hi, cnt, in_w = refill64(lo, hi, cnt, in_w)
+        bitbuf = lo
 
         # ---- DIST (phase B): distance code, refill, then extra bits.
         # A 15-bit code + 13 extra bits needs 28 valid bits but refill
@@ -497,10 +523,8 @@ def _inflate_simd_kernel(
         new_status = jnp.where(bad, 3, new_status)
         new_state = jnp.where(bad, _ERR, new_state)
         mok = m & ~bad
-        used_code = jnp.where(m, xbits, zrow)
-        bitbuf = bitbuf >> used_code.astype(_U32)
-        bitcnt = bitcnt - used_code
-        bitbuf, bitcnt, inpos = refill(bitbuf, bitcnt, inpos)
+        lo, hi, cnt = consume64(lo, hi, cnt, jnp.where(m, xbits, zrow))
+        bitbuf = lo
         dsym_c = jnp.clip(dsym, 0, 29)
         dext = _gather(dext_ref[...], dsym_c)
         dbase = _gather(dbase_ref[...], dsym_c)
@@ -511,48 +535,84 @@ def _inflate_simd_kernel(
         new_state = jnp.where(bad_d, _ERR, new_state)
         copy_dist = jnp.where(mok, dist, copy_dist)
         new_state = jnp.where(mok & ~bad_d, _COPY, new_state)
-        used_b = jnp.where(mok, dext, zrow)
-        bitbuf = bitbuf >> used_b.astype(_U32)
-        bitcnt = bitcnt - used_b
+        lo, hi, cnt = consume64(lo, hi, cnt, jnp.where(mok, dext, zrow))
 
-        # ---- COPY: one history byte per superstep --------------------
+        # ---- COPY: up to 4 history bytes per superstep ---------------
+        # Source bytes come from the 4 KiB circular history ring (last
+        # 4096 bytes, word rows = w & (RING_W-1)); distances past the
+        # ring window read the big out buffer under a gated cond. For
+        # d < 4 the 4 fetched bytes start at outpos-d and are replicated
+        # modularly (byte j := B[j mod d]), so only written bytes are
+        # ever read.
         m = (state == _COPY) & live
+        d = copy_dist
+        ck = jnp.minimum(kmax, copy_len)
+        base = outpos - d
+        bw = base >> 2
+        bo = ((base & 3) << 3).astype(_U32)
+        rw0 = _gather(ring_ref[...], jnp.where(m, bw & (RING_W - 1), -1))
+        rw1 = _gather(ring_ref[...],
+                      jnp.where(m, (bw + 1) & (RING_W - 1), -1))
+        far = m & (d > RING_SAFE)
 
-        def hist_byte():
-            src = outpos - copy_dist
-            word = _gather(out_ref[...], jnp.minimum(src >> 2, ow - 1))
-            sh = ((src & 3) << 3).astype(_U32)
-            return ((word >> sh) & 0xFF).astype(_I32)
+        def far_fetch():
+            f0 = _gather(out_ref[...],
+                         jnp.where(far, jnp.minimum(bw, ow - 1), -1))
+            f1 = _gather(out_ref[...],
+                         jnp.where(far, jnp.minimum(bw + 1, ow - 1), -1))
+            return f0, f1
 
-        cbyte = lax.cond(
-            jnp.any(m), hist_byte, lambda: zrow)
-        emit = emit | m
-        emit_byte = jnp.where(m, cbyte, emit_byte)
-        copy_len = jnp.where(m, copy_len - 1, copy_len)
+        fw0, fw1 = lax.cond(
+            jnp.any(far), far_fetch, lambda: (zrow_u, zrow_u))
+        w0 = jnp.where(far, fw0, rw0)
+        w1 = jnp.where(far, fw1, rw1)
+        asm = jnp.where(
+            bo == 0, w0, (w0 >> bo) | (w1 << ((_U32(32) - bo) & _U32(31))))
+        b0 = asm & 0xFF
+        b1 = (asm >> 8) & 0xFF
+        b2 = (asm >> 16) & 0xFF
+        b3 = (asm >> 24) & 0xFF
+        # modular replication for d in {1,2,3}
+        r1 = b0 | (b0 << 8) | (b0 << 16) | (b0 << 24)
+        r2 = b0 | (b1 << 8) | (b0 << 16) | (b1 << 24)
+        r3 = b0 | (b1 << 8) | (b2 << 16) | (b0 << 24)
+        cpk = jnp.where(d == 1, r1,
+                        jnp.where(d == 2, r2,
+                                  jnp.where(d == 3, r3, asm)))
+        emit_k = jnp.where(m, ck, emit_k)
+        packed = jnp.where(m, cpk, packed)
+        copy_len = jnp.where(m, copy_len - ck, copy_len)
         new_state = jnp.where(m & (copy_len == 0), _DECODE, new_state)
 
         # ---- emit merge ---------------------------------------------
-        emit = emit & live & (new_state != _ERR)
-        over = emit & (outpos >= ow * 4)
+        emit_k = jnp.where(live & (new_state != _ERR), emit_k, zrow)
+        over = (emit_k > 0) & (outpos + emit_k > ow * 4)
         new_status = jnp.where(over, 5, new_status)
         new_state = jnp.where(over, _ERR, new_state)
-        emit = emit & ~over
-        wrow = outpos >> 2
-        wsh = ((outpos & 3) << 3).astype(_U32)
+        emit_k = jnp.where(over, 0, emit_k)
+        emitting = emit_k > 0
+        kmask = _mask_bits(emit_k << 3)
+        bits = (packed & kmask) << ((off << 3).astype(_U32))
+        # big out: bytes land exactly once, buffer starts zeroed -> OR;
+        # mask folded into the row (-1 matches nothing): pure one-hot
+        wrow = jnp.where(emitting, outpos >> 2, -1)
         cur = out_ref[...]
-        out_ref[...] = jnp.where(
-            (_riota(ow) == wrow) & emit,
-            cur | (emit_byte.astype(_U32) << wsh),
-            cur)
-        outpos = outpos + jnp.where(emit, 1, 0)
+        out_ref[...] = jnp.where(_riota(ow) == wrow, cur | bits, cur)
+        # history ring: same word, replace-semantics (rows recycle)
+        rrow = jnp.where(emitting, (outpos >> 2) & (RING_W - 1), -1)
+        curr = ring_ref[...]
+        bmask = kmask << ((off << 3).astype(_U32))
+        ring_ref[...] = jnp.where(
+            _riota(RING_W) == rrow, (curr & ~bmask) | bits, curr)
+        outpos = outpos + emit_k
 
         # ---- input-overrun guard ------------------------------------
-        consumed = (inpos << 3) - bitcnt
+        consumed = (in_w << 5) - cnt
         overrun = live & (consumed > ((clen + 8) << 3))
         new_status = jnp.where(overrun, 6, new_status)
         new_state = jnp.where(overrun, _ERR, new_state)
 
-        return (step + 1, new_state, bitbuf, bitcnt, inpos, outpos,
+        return (step + 1, new_state, lo, hi, cnt, in_w, outpos,
                 bfinal, fixed, copy_len, copy_dist, hlit, hdist, hclen,
                 tb_idx, tb_nread, rep_val, rep_cnt, prev_len, new_status)
 
@@ -563,13 +623,13 @@ def _inflate_simd_kernel(
 
     init_state = jnp.where(clen > 0, _HEADER, _DONE)
     init = (
-        jnp.int32(0), init_state, zrow_u, zrow, zrow, zrow,
+        jnp.int32(0), init_state, zrow_u, zrow_u, zrow, zrow, zrow,
         zrow, zrow, zrow, zrow,
         zrow, zrow, zrow, zrow, zrow, zrow, zrow, zrow, zrow,
     )
     final = lax.while_loop(cond, superstep, init)
-    step, state, _bb, _bc, _ip, outpos = final[:6]
-    status = final[18]
+    step, state, _lo, _hi, _cnt, _iw, outpos = final[:7]
+    status = final[19]
     # lanes still live at the step cap ran away
     status = jnp.where(
         (state != _DONE) & (state != _ERR), 6, status)
@@ -605,6 +665,7 @@ def _compiled(cw: int, ow: int, interpret: bool):
             t16, t16, t16, t16,                    # lit cnt/first/off/curs
             t16, t16, t16, t16,                    # dist
             t8, t8, t8, t8,                        # cl
+            pltpu.VMEM((RING_W, LANES), _U32),     # history ring
         ],
         interpret=interpret,
     )

@@ -1,0 +1,127 @@
+"""Probe the two unknowns gating the ring redesign:
+P1: per-lane column DMA (VMEM->VMEM, (128,1) i32, dynamic row start
+    read from SMEM) issued in a scalar fori over 128 lanes.
+P2: lax.cond(jnp.any(vec cond)) cost, taken vs not-taken branch.
+Slope-measured (20k vs 100k outer iterations)."""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+I32 = jnp.int32
+
+
+def run_kernel(kernel, n_steps, scratch_shapes, nout=1):
+    comp = np.zeros((16384, LANES), np.int32)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, LANES), I32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch_shapes,
+    )
+    fn = jax.jit(call)
+    _ = np.asarray(fn(comp))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _ = np.asarray(fn(comp))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def p1(n_rounds, n_dma):
+    """n_rounds rounds; each: DMA state row to SMEM, scalar fori over
+    n_dma lanes issuing a (128,1) column DMA at an SMEM-read offset."""
+    def kernel(comp_ref, out_ref, ring_ref, pos_vmem, pos_smem, sems, csem):
+        out_ref[...] = jnp.zeros((8, LANES), I32)
+        pos_vmem[...] = jnp.zeros((1, LANES), I32)
+
+        def round_body(carry):
+            r = carry
+            cp = pltpu.make_async_copy(pos_vmem, pos_smem, csem)
+            cp.start()
+            cp.wait()
+
+            def lane_body(l, _):
+                off = pos_smem[0, l] + (r & 63)
+                d = pltpu.make_async_copy(
+                    comp_ref.at[pl.ds(off * 128, 128), pl.ds(l, 1)],
+                    ring_ref.at[:, pl.ds(l, 1)],
+                    sems.at[0],
+                )
+                d.start()
+                d.wait()
+                return 0
+
+            lax.fori_loop(0, n_dma, lane_body, 0)
+            return r + 1
+
+        def cond(r):
+            return r < n_rounds
+
+        lax.while_loop(cond, round_body, jnp.int32(0))
+        out_ref[0:1, :] = ring_ref[0:1, :] + pos_vmem[...]
+
+    return run_kernel(
+        kernel, n_rounds,
+        [pltpu.VMEM((128, LANES), I32),
+         pltpu.VMEM((1, LANES), I32),
+         pltpu.SMEM((1, LANES), I32),
+         pltpu.SemaphoreType.DMA((1,)),
+         pltpu.SemaphoreType.DMA],
+    )
+
+
+def p2(n_steps, taken):
+    """cond(any(vec)) per iteration; branch taken or not."""
+    def kernel(comp_ref, out_ref, acc_ref):
+        out_ref[...] = jnp.zeros((8, LANES), I32)
+        acc_ref[...] = jnp.full((1, LANES), 1 if taken else 0, I32)
+
+        def body(carry):
+            r, a = carry
+            pred = jnp.any(acc_ref[...] == 1)
+            b = lax.cond(pred,
+                         lambda: a + comp_ref[0:1, :] + 1,
+                         lambda: a)
+            return r + 1, b
+
+        def cond(c):
+            return c[0] < n_steps
+
+        _, a = lax.while_loop(cond, body, (jnp.int32(0),
+                                           jnp.zeros((1, LANES), I32)))
+        out_ref[0:1, :] = a
+
+    return run_kernel(kernel, n_steps, [pltpu.VMEM((1, LANES), I32)])
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "p1"):
+        for nd in (8, 32, 128):
+            t1 = p1(500, nd)
+            t2 = p1(2500, nd)
+            per_round = (t2 - t1) / 2000
+            print(f"P1 dma x{nd}/round: {per_round*1e6:.2f} us/round "
+                  f"({per_round/nd*1e9:.0f} ns/dma)")
+    if which in ("all", "p2"):
+        for taken in (False, True):
+            t1 = p2(20000, taken)
+            t2 = p2(100000, taken)
+            print(f"P2 cond(any) taken={taken}: "
+                  f"{(t2-t1)/80000*1e9:.0f} ns/step")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""P3: fused multi-word one-hot sweep costs (the DMA-free ring design).
+
+Per-lane column DMA is DEAD on this Mosaic: slices along the lane dim
+must be 128-aligned ("Slice shape along dimension 1 must be aligned to
+tiling (128), but is 1" — simd_prof2.py P1). So ring refill/flush must
+be one-hot sweeps. The open question: does gathering/scattering K
+consecutive words in ONE buffer traversal cost ~1 traversal (fused) or
+~K (not fused)?
+
+ G[K]: K-offset fused gather over (8192,128) i32 (4 MB)
+ S[K]: K-row fused scatter (nested wheres) over (16384,128) i32 (8 MB)
+ C:    cond(any((1,128) pred)) cost, taken vs not
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+I32 = jnp.int32
+
+
+def riota(r):
+    return lax.broadcasted_iota(I32, (r, LANES), 0)
+
+
+def bench(kernel, n_steps, scratch, nrep=3):
+    comp = np.zeros((8192, LANES), np.int32)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, LANES), I32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch,
+    )
+    fn = jax.jit(call)
+    _ = np.asarray(fn(comp))
+    best = 1e9
+    for _ in range(nrep):
+        t0 = time.perf_counter()
+        _ = np.asarray(fn(comp))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def slope(maker, n1=3000, n2=15000):
+    return (bench(maker(n2), n2, maker.scratch)
+            - bench(maker(n1), n1, maker.scratch)) / (n2 - n1)
+
+
+def gather_k(k):
+    def maker(n_steps):
+        def kernel(comp_ref, out_ref):
+            out_ref[...] = jnp.zeros((8, LANES), I32)
+
+            def body(carry):
+                s, acc = carry
+                rows = (acc & 4095)
+                data = comp_ref[...]
+                ri = riota(8192)
+                parts = acc
+                for j in range(k):
+                    parts = parts + jnp.sum(
+                        jnp.where(ri == rows + j, data, 0),
+                        axis=0, keepdims=True)
+                return s + 1, parts
+
+            _, acc = lax.while_loop(
+                lambda c: c[0] < n_steps, body,
+                (jnp.int32(0), jnp.zeros((1, LANES), I32)))
+            out_ref[0:1, :] = acc
+
+        return kernel
+
+    maker.scratch = []
+    return maker
+
+
+def scatter_k(k):
+    def maker(n_steps):
+        def kernel(comp_ref, out_ref, big_ref):
+            out_ref[...] = jnp.zeros((8, LANES), I32)
+            big_ref[...] = jnp.zeros((16384, LANES), I32)
+
+            def body(carry):
+                s, acc = carry
+                rows = (acc & 8191)
+                ri = riota(16384)
+                cur = big_ref[...]
+                upd = cur
+                for j in range(k):
+                    upd = jnp.where(ri == rows + j, acc + j, upd)
+                big_ref[...] = upd
+                return s + 1, acc + 1
+
+            _, acc = lax.while_loop(
+                lambda c: c[0] < n_steps, body,
+                (jnp.int32(0), jnp.zeros((1, LANES), I32)))
+            out_ref[0:1, :] = acc + big_ref[0:1, :]
+
+        return kernel
+
+    maker.scratch = [pltpu.VMEM((16384, LANES), I32)]
+    return maker
+
+
+def cond_any(taken):
+    def maker(n_steps):
+        def kernel(comp_ref, out_ref):
+            out_ref[...] = jnp.zeros((8, LANES), I32)
+            flag = comp_ref[0:1, :] + (1 if taken else 0)
+
+            def body(carry):
+                s, acc = carry
+                b = lax.cond(jnp.any(flag == 1),
+                             lambda: acc + comp_ref[1:2, :] + 1,
+                             lambda: acc)
+                return s + 1, b
+
+            _, acc = lax.while_loop(
+                lambda c: c[0] < n_steps, body,
+                (jnp.int32(0), jnp.zeros((1, LANES), I32)))
+            out_ref[0:1, :] = acc
+
+        return kernel
+
+    maker.scratch = []
+    return maker
+
+
+def main():
+    for k in (1, 2, 4, 8):
+        s = slope(gather_k(k))
+        print(f"G[{k}]: {s*1e6:.2f} us/step ({s/k*1e9:.0f} ns/word, "
+              f"{4*128*k/s/1e9:.1f} GB/s yield)")
+    for k in (1, 2, 4, 8):
+        s = slope(scatter_k(k), 1500, 7500)
+        print(f"S[{k}]: {s*1e6:.2f} us/step ({s/k*1e9:.0f} ns/word)")
+    for taken in (False, True):
+        s = slope(cond_any(taken), 20000, 100000)
+        print(f"C taken={taken}: {s*1e9:.0f} ns/step")
+
+
+if __name__ == "__main__":
+    main()

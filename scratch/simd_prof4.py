@@ -1,0 +1,139 @@
+"""P4: does an extra boolean mask defeat the one-hot fast path?
+
+ Ga: pure gather          sum(where(ri==rows, data, 0))       (16384,128)
+ Gb: masked gather        sum(where((ri==rows)&m, data, 0))
+ Gc: mask folded in rows  rows' = where(m, rows, -1), pure form
+ Sa: pure scatter         where(ri==rows, v, cur)
+ Sb: masked scatter       where((ri==rows)&m, v, cur)
+ Sc: folded scatter       rows' = where(m, rows, -1)
+ RMW: scatter of cur|v<<sh (the emit shape)
+ C:  cond(any(pred)) taken / not taken
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+I32 = jnp.int32
+R = 16384
+
+
+def riota(r):
+    return lax.broadcasted_iota(I32, (r, LANES), 0)
+
+
+def bench(kernel, scratch):
+    comp = np.zeros((R, LANES), np.int32)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, LANES), I32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch,
+    )
+    fn = jax.jit(call)
+    _ = np.asarray(fn(comp))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _ = np.asarray(fn(comp))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def loop(body_fn, n_steps, scratch):
+    def kernel(comp_ref, out_ref, *scr):
+        out_ref[...] = jnp.zeros((8, LANES), I32)
+        for s in scr:
+            s[...] = jnp.zeros(s.shape, s.dtype)
+
+        def body(carry):
+            s, acc = carry
+            acc = body_fn(s, acc, comp_ref, scr)
+            return s + 1, acc
+
+        _, acc = lax.while_loop(lambda c: c[0] < n_steps, body,
+                                (jnp.int32(0), jnp.zeros((1, LANES), I32)))
+        out_ref[0:1, :] = acc
+
+    return kernel
+
+
+def slope(body_fn, scratch, n1=3000, n2=15000):
+    t1 = bench(loop(body_fn, n1, scratch), scratch)
+    t2 = bench(loop(body_fn, n2, scratch), scratch)
+    return (t2 - t1) / (n2 - n1)
+
+
+def main():
+    big = [pltpu.VMEM((R, LANES), I32)]
+
+    def ga(s, acc, comp, scr):
+        rows = acc & (R - 1)
+        return acc + jnp.sum(jnp.where(riota(R) == rows, comp[...], 0),
+                             axis=0, keepdims=True)
+
+    def gb(s, acc, comp, scr):
+        rows = acc & (R - 1)
+        m = (acc & 1) == 0
+        return acc + jnp.sum(
+            jnp.where((riota(R) == rows) & m, comp[...], 0),
+            axis=0, keepdims=True)
+
+    def gc(s, acc, comp, scr):
+        m = (acc & 1) == 0
+        rows = jnp.where(m, acc & (R - 1), -1)
+        return acc + jnp.sum(jnp.where(riota(R) == rows, comp[...], 0),
+                             axis=0, keepdims=True)
+
+    def sa(s, acc, comp, scr):
+        rows = acc & (R - 1)
+        scr[0][...] = jnp.where(riota(R) == rows, acc, scr[0][...])
+        return acc + 1
+
+    def sb(s, acc, comp, scr):
+        rows = acc & (R - 1)
+        m = (acc & 1) == 0
+        scr[0][...] = jnp.where((riota(R) == rows) & m, acc, scr[0][...])
+        return acc + 1
+
+    def sc(s, acc, comp, scr):
+        m = (acc & 1) == 0
+        rows = jnp.where(m, acc & (R - 1), -1)
+        scr[0][...] = jnp.where(riota(R) == rows, acc, scr[0][...])
+        return acc + 1
+
+    def rmw(s, acc, comp, scr):
+        rows = acc & (R - 1)
+        cur = scr[0][...]
+        scr[0][...] = jnp.where(riota(R) == rows, cur | (acc << 8), cur)
+        return acc + 1
+
+    for name, fn, scr in (("Ga", ga, []), ("Gb", gb, []), ("Gc", gc, []),
+                          ("Sa", sa, big), ("Sb", sb, big), ("Sc", sc, big),
+                          ("RMW", rmw, big)):
+        try:
+            print(f"{name}: {slope(fn, scr)*1e6:.3f} us/step")
+        except Exception as e:
+            print(f"{name}: FAIL {str(e)[:80]}")
+
+    for taken in (False, True):
+        def c(s, acc, comp, scr, taken=taken):
+            pred = comp[0:1, :] + (1 if taken else 0)
+            return lax.cond(jnp.any(pred == 1),
+                            lambda: acc + comp[1:2, :] + 1,
+                            lambda: acc)
+        print(f"C taken={taken}: {slope(c, [], 20000, 100000)*1e9:.0f} ns/step")
+
+
+if __name__ == "__main__":
+    main()

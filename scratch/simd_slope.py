@@ -32,8 +32,9 @@ def run(fn, payloads, usizes, reps=5):
 
 def main():
     rng = np.random.default_rng(0)
-    pad_to = 7200
-    sizes = (6000, 26000)
+    import sys as _s
+    pad_to = int(_s.argv[1]) if len(_s.argv) > 1 else 7200
+    sizes = (int(_s.argv[2]), int(_s.argv[3])) if len(_s.argv) > 3 else (6000, 26000)
     results = {}
     for n in sizes:
         raws = [make(n, rng) for _ in range(128)]
@@ -45,9 +46,10 @@ def main():
         ok = all(g == r for g, r in zip(got, raws))
         results[n] = t
         print(f"n={n}: best={t:.3f}s correct={ok}")
+    a, b = sizes
     ss = {n: int(n * 1.35) for n in sizes}
-    slope = (results[26000] - results[6000]) / (ss[26000] - ss[6000])
-    tput = 128 * (sizes[1] - sizes[0]) / (results[26000] - results[6000]) / 1e6
+    slope = (results[b] - results[a]) / (ss[b] - ss[a])
+    tput = 128 * (b - a) / (results[b] - results[a]) / 1e6
     print(f"slope ~= {slope*1e6:.2f} us/superstep; marginal {tput:.1f} MB/s")
 
 
