@@ -68,8 +68,13 @@ def synth_bam(path: str, n: int) -> None:
         name_offsets=name_off, names=np.frombuffer(b"".join(names_list), np.uint8).copy(),
         cigar_offsets=np.arange(n + 1, dtype=np.int64), cigars=cigars,
         seq_offsets=seq_off,
-        seqs=rng.integers(1, 16, n * readlen, dtype=np.uint8) & np.uint8(0xF),
-        quals=rng.integers(0, 42, n * readlen, dtype=np.uint8),
+        # motif-drawn bases + run-structured quals: zlib sees ~3-4x like
+        # real genomic data (uniform-random bytes compress ~1.4x and
+        # would misrepresent every codec-path measurement)
+        seqs=np.tile(rng.integers(1, 16, 4096, dtype=np.uint8),
+                     (n * readlen + 4095) // 4096)[: n * readlen],
+        quals=np.repeat(rng.integers(28, 42, (n * readlen + 19) // 20,
+                                     dtype=np.uint8), 20)[: n * readlen],
         tag_offsets=np.zeros(n + 1, dtype=np.int64), tags=np.zeros(0, np.uint8),
     )
     header = SamHeader.build(REFS)
@@ -187,6 +192,138 @@ def _spread(times) -> float:
     return round((max(times) - min(times)) / med, 3) if med else 0.0
 
 
+def secondary_configs(storage, path: str, tmp: str, reps: int) -> dict:
+    """BASELINE.md matrix configs 3-5 (config 2 differs from 1 only in
+    input scale). Each reports its own median + spread."""
+    from disq_tpu import VariantsStorage
+    from disq_tpu.api import (
+        BaiWriteOption, Interval, TraversalParameters, VariantsDataset,
+    )
+    from disq_tpu.vcf.columnar import parse_vcf_lines
+    from disq_tpu.vcf.header import VcfHeader
+
+    vcf_hdr_text = (
+        "##fileformat=VCFv4.3\n"
+        '##contig=<ID=chr1,length=248956422>\n'
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="depth">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    )
+
+    out = {}
+    n = N_RECORDS
+
+    # --- 4: unsorted -> coordinate sort -> write BAM + BAI ---
+    sorted_path = os.path.join(tmp, "sorted.bam")
+
+    def run4():
+        ds = storage.read(path)
+        storage.write(ds.coordinate_sorted(), sorted_path,
+                      BaiWriteOption.ENABLE)
+
+    run4()
+    med4, t4 = _timed(run4, reps)
+    out["4_sort_write_bam_bai"] = {
+        "records_per_sec": round(n / med4, 1), "spread": _spread(t4),
+    }
+
+    # --- 3: interval-filtered read via traversal + BAI ---
+    tp = TraversalParameters(intervals=(
+        Interval("chr1", 1, 400_000),
+        Interval("chr20", 200_000, 900_000),
+    ))
+
+    def run3():
+        storage.read(sorted_path, traversal=tp).count()
+
+    run3()
+    med3, t3 = _timed(run3, reps)
+    sel = storage.read(sorted_path, traversal=tp).count()
+    out["3_interval_read_bai"] = {
+        "wall_sec": round(med3, 4), "records_selected": sel,
+        "spread": _spread(t3),
+    }
+
+    # --- 5a: CRAM write+read (reference-less: bases embedded) ---
+    cram_path = os.path.join(tmp, "bench.cram")
+    storage.write(storage.read(path).coordinate_sorted(), cram_path)
+
+    def run5():
+        assert storage.read(cram_path).count() == n
+
+    run5()
+    med5, t5 = _timed(run5, reps)
+    out["5a_cram_read"] = {
+        "records_per_sec": round(n / med5, 1), "spread": _spread(t5),
+    }
+
+    # --- 5b: VCF/BCF read ---
+    nv = 100_000
+    rng = np.random.default_rng(1)
+    pos = np.sort(rng.integers(1, 10_000_000, nv))
+    lines = [
+        f"chr1\t{p}\t.\tA\tG\t50\tPASS\tDP={30 + i % 40}"
+        for i, p in enumerate(pos)
+    ]
+    header = VcfHeader.from_text(vcf_hdr_text)
+    batch = parse_vcf_lines(
+        [l.encode() for l in lines], header.contig_names)
+    vst = VariantsStorage.make_default()
+    bcf_path = os.path.join(tmp, "bench.bcf")
+    vst.write(VariantsDataset(header=header, variants=batch), bcf_path)
+
+    def run5b():
+        assert vst.read(bcf_path).count() == nv
+
+    run5b()
+    med5b, t5b = _timed(run5b, reps)
+    out["5b_bcf_read"] = {
+        "records_per_sec": round(nv / med5b, 1), "spread": _spread(t5b),
+    }
+    return out
+
+
+def device_inflate_config(path: str) -> dict:
+    """Device-kernel row: SIMD Pallas inflate MB/s over the bench BAM's
+    BGZF blocks, real chip only (skipped on CPU-only hosts)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from disq_tpu.bgzf.codec import inflate_blocks_device
+    from disq_tpu.bgzf.guesser import find_block_table
+    from disq_tpu.fsw import PosixFileSystemWrapper
+
+    fs = PosixFileSystemWrapper()
+    blocks = [b for b in find_block_table(fs, path) if b.usize > 0]
+    with open(path, "rb") as f:
+        data = f.read()
+    total = sum(b.usize for b in blocks)
+    from disq_tpu.ops import inflate_simd
+
+    n_dev = sum(1 for b in blocks
+                if b.csize - 26 <= inflate_simd.MAX_DEVICE_CSIZE)
+    inflate_blocks_device(data, blocks)  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        inflate_blocks_device(data, blocks)
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    return {
+        "device_inflate": {
+            "mb_per_sec": round(total / med / 1e6, 2),
+            "raw_mb": round(total / 1e6, 2),
+            "spread": _spread(times),
+            "device_served_blocks": n_dev,
+            "host_fallback_blocks": len(blocks) - n_dev,
+            # end-to-end number includes host<->device transfer; on the
+            # axon dev tunnel H2D moves at ~12 MB/s, so kernel-side
+            # throughput is recorded separately in TPU_KERNELS.json
+            "note": "e2e incl. transfer; kernel MB/s in TPU_KERNELS.json",
+        }
+    }
+
+
 def main() -> None:
     tmp = tempfile.mkdtemp(prefix="disq_bench_")
     path = os.path.join(tmp, "bench.bam")
@@ -228,6 +365,8 @@ def main() -> None:
             "baseline_cores": ncpu,
         },
     }
+    configs.update(secondary_configs(storage, path, tmp, max(2, REPS - 2)))
+    configs.update(device_inflate_config(path))
 
     print(
         json.dumps(

@@ -68,6 +68,7 @@ LANES = 128
 _MAXLENS = 320          # 288 lit/len + 32 dist code lengths
 RING_W = 1024           # history ring: last 4 KiB per lane, word rows
 RING_SAFE = 4096 - 8    # max distance served by the ring
+MAX_DEVICE_CSIZE = 4096 * 4 - 16  # comp cap; bigger payloads -> host
 _U32 = jnp.uint32
 _I32 = jnp.int32
 
@@ -679,6 +680,28 @@ def _bucket(n: int, lo: int = 64) -> int:
     return b
 
 
+def _pack_chunk(chunk: Sequence[bytes], cw: int):
+    """Pack <=128 payloads into the kernel's (cw,128) LE word columns +
+    (1,128) byte lengths. Single source of truth — the TPU CI lane's
+    kernel-only row packs with this too."""
+    comp = np.zeros((cw, LANES), dtype="<u4")
+    clen = np.zeros((1, LANES), dtype=np.int32)
+    for i, p in enumerate(chunk):
+        clen[0, i] = len(p)
+        pad = (-len(p)) % 4
+        w = np.frombuffer(p + b"\x00" * pad, dtype="<u4")
+        comp[: len(w), i] = w
+    return comp.view(np.uint32), clen
+
+
+def buckets_for(payloads: Sequence[bytes], max_u: int):
+    """The (cw, ow) the production wrapper would compile for."""
+    max_c = max(len(p) for p in payloads)
+    cw = _bucket((max_c + 8) // 4 + 2)
+    ow = min(_bucket(max(1, (max_u + 3) // 4)), 16384)
+    return cw, ow
+
+
 def inflate_payloads_simd(
     payloads: Sequence[bytes],
     usizes: Optional[Sequence[int]] = None,
@@ -696,11 +719,12 @@ def inflate_payloads_simd(
         interpret = jax.default_backend() != "tpu"
     if not payloads:
         return []
-    # VMEM budget (~16 MB/core): comp (8192,128) u32 = 4 MB + out
-    # (16384,128) u32 = 8 MB + ~0.5 MB tables. Payloads too big for the
-    # comp cap (possible only for near-incompressible data — BAM BGZF
-    # payloads compress ~3-4x) go to host zlib.
-    max_csize = 8192 * 4 - 16
+    # VMEM budget (~16 MB/core): with out (16384,128) u32 = 8 MB the
+    # largest comp buffer Mosaic will still allocate is (4096,128) u32 =
+    # 4 MB (cw 8192 exceeds the scoped-vmem limit at compile). Payloads
+    # over the comp cap go to host zlib; the segmented-output layout
+    # lifts this to 32 KiB.
+    max_csize = MAX_DEVICE_CSIZE
     big = [i for i, p in enumerate(payloads) if len(p) > max_csize]
     if big:
         import zlib as _z
@@ -724,21 +748,31 @@ def inflate_payloads_simd(
     ow = min(_bucket(max(1, (max_u + 3) // 4)), 16384)
     fn = _compiled(cw, ow, interpret)
 
+    # pipelined dispatch: keep a small window of chunks in flight so
+    # H2D transfer, compute and D2H overlap, without holding every
+    # chunk's device buffers (~12 MB each) alive at once
+    consts = tuple(jnp.asarray(t) for t in _CONST_TABLES)
+    chunks = [payloads[lo: lo + LANES]
+              for lo in range(0, len(payloads), LANES)]
+    window = 3
+    launched: List = []
+
+    def launch(chunk):
+        comp, clen = _pack_chunk(chunk, cw)
+        return fn(jnp.asarray(comp), jnp.asarray(clen), *consts)
+
+    for chunk in chunks[:window]:
+        launched.append(launch(chunk))
+
     out: List[bytes] = []
-    for lo in range(0, len(payloads), LANES):
-        chunk = payloads[lo: lo + LANES]
-        comp = np.zeros((cw, LANES), dtype="<u4")
-        clen = np.zeros((1, LANES), dtype=np.int32)
-        for i, p in enumerate(chunk):
-            clen[0, i] = len(p)
-            pad = (-len(p)) % 4
-            w = np.frombuffer(p + b"\x00" * pad, dtype="<u4")
-            comp[: len(w), i] = w
-        words, meta = fn(jnp.asarray(comp.view(np.uint32)),
-                         jnp.asarray(clen),
-                         *(jnp.asarray(t) for t in _CONST_TABLES))
+    for ci, chunk in enumerate(chunks):
+        lo = ci * LANES
+        words, meta = launched[ci]
         words = np.asarray(words)
         meta = np.asarray(meta)
+        launched[ci] = None
+        if ci + window < len(chunks):
+            launched.append(launch(chunks[ci + window]))
         for i, p in enumerate(chunk):
             n, status = int(meta[0, i]), int(meta[1, i])
             expect = None if usizes is None else int(usizes[lo + i])
