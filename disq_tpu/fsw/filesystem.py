@@ -9,9 +9,11 @@ Reference parity (see SURVEY.md §2.2):
 - ``PathSplit`` + ``compute_path_splits`` ← ``impl/file/PathSplitSource.java``
   / ``PathSplit.java`` (file → byte-range splits of ``split_size``)
 
-A GCS wrapper is intentionally gated: this build has zero egress. The
-registry (`get_filesystem`) dispatches on URI scheme so a `gs://` wrapper
-can slot in without touching call sites.
+Remote URIs (``http(s)://``, ``gs://``, ``s3://``) dispatch to the HTTP
+range-read wrapper (``disq_tpu.fsw.http``) — ``HadoopFileSystemWrapper``'s
+remote role; gs/s3 map to their public endpoints, so touching them DOES
+issue network requests. ``register_filesystem`` installs authenticated or
+alternative wrappers per scheme without touching call sites.
 """
 
 from __future__ import annotations
@@ -202,18 +204,32 @@ class MemoryFileSystemWrapper(FileSystemWrapper):
 
 
 _POSIX = PosixFileSystemWrapper()
+_SCHEME_REGISTRY: dict = {}
+
+
+def register_filesystem(scheme: str, fs: FileSystemWrapper) -> None:
+    """Install a wrapper for ``scheme`` (e.g. an authenticated blob
+    client); overrides the built-in dispatch below."""
+    _SCHEME_REGISTRY[scheme] = fs
 
 
 def resolve_path(path: str) -> Tuple[FileSystemWrapper, str]:
     """Scheme dispatch: URI → (wrapper, normalized path).
 
-    ``gs://`` is recognised but gated (zero egress).
+    Remote schemes (``http(s)://``, ``gs://``, ``s3://``) resolve to the
+    HTTP range-read wrapper (``disq_tpu.fsw.http``) — gs/s3 via their
+    public endpoints; authenticated access installs a wrapper through
+    ``register_filesystem``.
     """
-    if path.startswith("gs://") or path.startswith("s3://"):
-        raise NotImplementedError(
-            f"remote filesystem for {path!r} is gated in this build "
-            "(no network egress); register a wrapper via scheme dispatch"
-        )
+    scheme = path.split("://", 1)[0] if "://" in path else ""
+    if scheme in _SCHEME_REGISTRY:
+        return _SCHEME_REGISTRY[scheme], path
+    if scheme in ("http", "https", "gs", "s3"):
+        from disq_tpu.fsw.http import HttpFileSystemWrapper
+
+        fs = HttpFileSystemWrapper()
+        _SCHEME_REGISTRY.setdefault(scheme, fs)
+        return _SCHEME_REGISTRY[scheme], path
     if path.startswith("file://"):
         path = path[len("file://"):]
     return _POSIX, path

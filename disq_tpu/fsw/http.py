@@ -1,0 +1,247 @@
+"""HTTP range-read filesystem wrapper — the remote FSW.
+
+Replaces the reference's ``HadoopFileSystemWrapper`` remote role
+(``impl/file/HadoopFileSystemWrapper.java``: Hadoop FileSystem URIs —
+gs://, s3a://, hdfs:// — behind the same interface). The TPU-native
+equivalent speaks plain HTTP/1.1 range requests, which is the wire
+protocol every blob store exposes:
+
+- ``http(s)://`` — used directly.
+- ``gs://bucket/key`` — ``https://storage.googleapis.com/bucket/key``
+  (public objects / anonymous access; authenticated access needs a
+  credential signer, which this zero-egress build gates).
+- ``s3://bucket/key`` — ``https://bucket.s3.amazonaws.com/key``.
+
+Reads are served from a block cache of fixed-size ranges with an
+**async prefetch** of the next block on every cache miss, so a
+sequential scan (the BamSource staging pattern) always has the next
+range in flight while the current one decodes. The wrapper's ``stats``
+(range_requests / bytes_fetched / prefetch_issued / prefetch_hits)
+makes the staging behavior observable and testable.
+
+Writes are not supported (the reference writes through Hadoop's
+committer; our sinks stage locally and upload out-of-band).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import BinaryIO, List, Tuple
+
+from disq_tpu.fsw.filesystem import FileSystemWrapper
+
+DEFAULT_BLOCK = 4 * 1024 * 1024
+
+
+def rewrite_remote_uri(path: str) -> str:
+    """gs:// and s3:// → their public HTTP endpoints."""
+    if path.startswith("gs://"):
+        return "https://storage.googleapis.com/" + path[len("gs://"):]
+    if path.startswith("s3://"):
+        bucket, _, key = path[len("s3://"):].partition("/")
+        return f"https://{bucket}.s3.amazonaws.com/{key}"
+    return path
+
+
+class _Stats:
+    __slots__ = ("range_requests", "bytes_fetched", "prefetch_hits",
+                 "prefetch_issued")
+
+    def __init__(self) -> None:
+        self.range_requests = 0
+        self.bytes_fetched = 0
+        self.prefetch_hits = 0
+        self.prefetch_issued = 0
+
+
+class HttpFileSystemWrapper(FileSystemWrapper):
+    """Read-only remote FSW over HTTP range requests."""
+
+    def __init__(self, block_size: int = DEFAULT_BLOCK,
+                 prefetch: bool = True, max_cached_blocks: int = 32) -> None:
+        self.block_size = block_size
+        self.prefetch = prefetch
+        self.max_cached_blocks = max_cached_blocks
+        self.stats = _Stats()
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._lock = threading.Lock()
+        # (url, block_index) -> bytes or in-flight Future; LRU-bounded
+        # (the wrapper is process-global via the scheme registry, so an
+        # unbounded cache would retain a whole remote file)
+        self._cache: "OrderedDict[Tuple[str, int], object]" = OrderedDict()
+        self._lengths: dict = {}
+
+    def _cache_put(self, key, value) -> None:
+        # caller holds self._lock
+        self._cache[key] = value
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.max_cached_blocks:
+            old_key, old = next(iter(self._cache.items()))
+            if isinstance(old, Future) and not old.done():
+                break  # never drop an in-flight prefetch
+            self._cache.pop(old_key)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _fetch(self, url: str, start: int, end_incl: int) -> bytes:
+        req = urllib.request.Request(
+            url, headers={"Range": f"bytes={start}-{end_incl}"})
+        with urllib.request.urlopen(req) as resp:
+            data = resp.read()
+        with self._lock:
+            self.stats.range_requests += 1
+            self.stats.bytes_fetched += len(data)
+        return data
+
+    def _block(self, url: str, idx: int, length: int) -> bytes:
+        key = (url, idx)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+        if isinstance(entry, bytes):
+            return entry
+        if isinstance(entry, Future):
+            try:
+                data = entry.result()
+            except Exception:
+                # transient prefetch failure must not poison the block:
+                # drop the future and fetch inline (which may raise a
+                # fresh, retryable error)
+                with self._lock:
+                    if self._cache.get(key) is entry:
+                        self._cache.pop(key)
+                entry = None
+            else:
+                with self._lock:
+                    self._cache_put(key, data)
+                    self.stats.prefetch_hits += 1
+                return data
+        start = idx * self.block_size
+        end = min(start + self.block_size, length) - 1
+        data = self._fetch(url, start, end)
+        with self._lock:
+            self._cache_put(key, data)
+        # async prefetch of the NEXT block while the caller decodes
+        nxt = idx + 1
+        if self.prefetch and nxt * self.block_size < length:
+            nkey = (url, nxt)
+            with self._lock:
+                if nkey not in self._cache:
+                    ns = nxt * self.block_size
+                    ne = min(ns + self.block_size, length) - 1
+                    self._cache_put(nkey, self._pool.submit(
+                        self._fetch, url, ns, ne))
+                    self.stats.prefetch_issued += 1
+        return data
+
+    # -- FileSystemWrapper interface --------------------------------------
+
+    def exists(self, path: str) -> bool:
+        url = rewrite_remote_uri(path)
+        req = urllib.request.Request(url, method="HEAD")
+        try:
+            with urllib.request.urlopen(req) as resp:
+                clen = resp.headers.get("Content-Length")
+                if clen is None:
+                    # a length-less HEAD would make every read clamp to
+                    # b"" — fail loudly instead
+                    raise IOError(
+                        f"HEAD {url} returned no Content-Length; "
+                        "range staging needs a sized object")
+                self._lengths[url] = int(clen)
+            return True
+        except urllib.error.HTTPError as e:
+            # S3 answers 403 for missing keys without list permission
+            if e.code in (403, 404):
+                return False
+            raise
+
+    def get_file_length(self, path: str) -> int:
+        url = rewrite_remote_uri(path)
+        if url not in self._lengths:
+            if not self.exists(path):
+                raise FileNotFoundError(path)
+        return self._lengths[url]
+
+    def read_range(self, path: str, start: int, length: int) -> bytes:
+        url = rewrite_remote_uri(path)
+        total = self.get_file_length(path)
+        end = min(start + length, total)
+        if end <= start:
+            return b""
+        first = start // self.block_size
+        last = (end - 1) // self.block_size
+        parts: List[bytes] = []
+        for idx in range(first, last + 1):
+            blk = self._block(url, idx, total)
+            lo = max(start - idx * self.block_size, 0)
+            hi = min(end - idx * self.block_size, len(blk))
+            parts.append(blk[lo:hi])
+        return b"".join(parts)
+
+    def open(self, path: str) -> BinaryIO:
+        return _HttpReader(self, path)
+
+    def create(self, path: str) -> BinaryIO:
+        raise NotImplementedError(
+            "remote HTTP filesystem is read-only; sinks stage locally")
+
+    def list_directory(self, path: str) -> List[str]:
+        raise NotImplementedError(
+            "HTTP has no directory listing; pass explicit object paths")
+
+    def is_directory(self, path: str) -> bool:
+        return False
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        raise NotImplementedError("remote HTTP filesystem is read-only")
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError("remote HTTP filesystem is read-only")
+
+
+class _HttpReader(io.RawIOBase):
+    """Seekable read stream over the block cache (htsjdk-style usage:
+    seek to a virtual offset's coffset, stream forward)."""
+
+    def __init__(self, fs: HttpFileSystemWrapper, path: str) -> None:
+        self._fs = fs
+        self._path = path
+        self._pos = 0
+        self._len = fs.get_file_length(path)
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = pos
+        elif whence == 1:
+            self._pos += pos
+        else:
+            self._pos = self._len + pos
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = self._len - self._pos
+        data = self._fs.read_range(self._path, self._pos, n)
+        self._pos += len(data)
+        return data
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
