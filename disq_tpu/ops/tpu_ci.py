@@ -144,6 +144,62 @@ def run_rans(results: list) -> None:
     assert ok, "device rANS != host"
 
 
+def run_deflate(results: list) -> None:
+    """Device DEFLATE encoder: committed ratio + throughput vs the
+    canonical zlib-6 pin on realistic payloads, with the stored-block
+    fallback count (VERDICT r4 item 9 / weak #6)."""
+    from disq_tpu.ops import deflate as dev_deflate
+
+    rng = np.random.default_rng(3)
+    # two payload classes: entropy-dominated (no LZ77 matches exist, so
+    # the entropy-only device coder can be compared head-on with zlib)
+    # and match-heavy (where the missing LZ77 stage shows — reported,
+    # not hidden)
+    entropy_blob = rng.integers(28, 42, 2_000_000,
+                                dtype=np.uint8).tobytes()
+    blob = _bam_like(2_000_000, rng)
+    ze = _deflate(entropy_blob)
+    ce, _ = dev_deflate.deflate_blob_device(entropy_blob)
+    entropy_row = {
+        "ratio_device": round(len(entropy_blob) / len(ce), 3),
+        "ratio_zlib6": round(len(entropy_blob) / len(ze), 3),
+        "stored_fallback_blocks": dev_deflate.last_stats["stored_fallback"],
+    }
+    comp, sizes = dev_deflate.deflate_blob_device(blob)
+    stats = dict(dev_deflate.last_stats)
+    # round-trip through an independent decoder
+    from disq_tpu.bgzf.block import parse_block_header
+
+    import struct
+
+    pos, back = 0, bytearray()
+    while pos < len(comp):
+        total = parse_block_header(comp, pos)
+        xlen = struct.unpack_from("<H", comp, pos + 10)[0]
+        back += zlib.decompress(comp[pos + 12 + xlen: pos + total - 8],
+                                wbits=-15)
+        pos += total
+    ok = bytes(back) == blob
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        dev_deflate.deflate_blob_device(blob)
+        best = min(best, time.perf_counter() - t0)
+    zbytes = _deflate(blob)
+    results.append({
+        "kernel": "deflate_device_encode",
+        "shape": f"{len(blob)} B",
+        "mb_per_sec": round(len(blob) / best / 1e6, 2),
+        "ratio_device": round(len(blob) / len(comp), 3),
+        "ratio_zlib6": round(len(blob) / len(zbytes), 3),
+        "stored_fallback_blocks": stats["stored_fallback"],
+        "blocks": stats["blocks"],
+        "entropy_payload": entropy_row,
+        "correct": ok,
+    })
+    assert ok, "device deflate round-trip mismatch"
+
+
 def main(out_path: str = "TPU_KERNELS.json") -> int:
     import jax
 
@@ -152,7 +208,8 @@ def main(out_path: str = "TPU_KERNELS.json") -> int:
         print(f"SKIP: backend is {backend}, not tpu")
         return 0
     results: list = []
-    for fn in (run_inflate_simd, run_inflate_legacy, run_rans):
+    for fn in (run_inflate_simd, run_inflate_legacy, run_rans,
+               run_deflate):
         try:
             fn(results)
         except Exception as e:  # record the failure, keep going
