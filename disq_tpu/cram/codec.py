@@ -3,10 +3,13 @@
 Replaces htsjdk's ``CramCompressionRecord`` + ``Cram(Record)Codec`` +
 ``CramNormalizer`` stack (SURVEY.md §2.5, §2.8). Profile implemented:
 
-- every data series is EXTERNAL (ITF8 ints / bytes in per-series blocks)
-  — a legal CRAM 3.0 layout; readers additionally understand
-  BYTE_ARRAY_STOP and BYTE_ARRAY_LEN (what we emit for names/arrays) and
-  reject exotic core codecs with a clear error;
+- write side emits every data series EXTERNAL (ITF8 ints / bytes in
+  per-series blocks) by default — a legal CRAM 3.0 layout — or, with
+  ``DISQ_TPU_CRAM_CORE``, routes CF/MQ/FN through CORE-block bit codecs
+  (canonical Huffman / BETA / GAMMA). The read side understands the
+  CORE bit codecs foreign htsjdk/samtools CRAMs use — full canonical
+  HUFFMAN, BETA, GAMMA and SUBEXP — plus BYTE_ARRAY_STOP and
+  BYTE_ARRAY_LEN, and rejects anything else with a clear error;
 - single-reference slices (ref runs split into slices), detached mate
   info, absolute AP;
 - sequence via read features: M-runs that match the reference are
@@ -38,6 +41,9 @@ E_EXTERNAL = 1
 E_HUFFMAN = 3
 E_BYTE_ARRAY_LEN = 4
 E_BYTE_ARRAY_STOP = 5
+E_BETA = 6
+E_SUBEXP = 7
+E_GAMMA = 9
 
 # CF compression bit flags
 CF_QS_STORED = 0x1
@@ -148,7 +154,160 @@ class Encoding:
             m = sub.itf8()
             lens = [sub.itf8() for _ in range(m)]
             return cls(codec, (syms, lens))
+        if codec == E_BETA:
+            return cls(codec, (sub.itf8(), sub.itf8()))  # offset, nbits
+        if codec == E_SUBEXP:
+            return cls(codec, (sub.itf8(), sub.itf8()))  # offset, k
+        if codec == E_GAMMA:
+            return cls(codec, sub.itf8())                # offset
         return cls(codec, None)
+
+
+class BitCursor:
+    """MSB-first bit reader over the CORE block (CRAM 3.0 §2:
+    "bit stream ... packed MSB first")."""
+
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def bit(self) -> int:
+        b = (self.data[self.pos >> 3] >> (7 - (self.pos & 7))) & 1
+        self.pos += 1
+        return b
+
+    def bits(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | self.bit()
+        return v
+
+
+class BitWriter:
+    """MSB-first bit writer (encode-side core block)."""
+
+    def __init__(self) -> None:
+        self.out = bytearray()
+        self._acc = 0
+        self._nb = 0
+
+    def write(self, value: int, nbits: int) -> None:
+        for i in range(nbits - 1, -1, -1):
+            self._acc = (self._acc << 1) | ((value >> i) & 1)
+            self._nb += 1
+            if self._nb == 8:
+                self.out.append(self._acc)
+                self._acc = 0
+                self._nb = 0
+
+    def flush(self) -> bytes:
+        if self._nb:
+            self.out.append(self._acc << (8 - self._nb))
+            self._acc = 0
+            self._nb = 0
+        return bytes(self.out)
+
+
+def huffman_code_lengths(freqs: Dict[int, int]) -> Dict[int, int]:
+    """Package-free Huffman code lengths (heap merge) for the observed
+    symbols; single-symbol alphabets get the zero-bit constant code."""
+    import heapq
+
+    if len(freqs) == 1:
+        return {next(iter(freqs)): 0}
+    heap = [(f, i, (s,)) for i, (s, f) in enumerate(sorted(freqs.items()))]
+    heapq.heapify(heap)
+    depth: Dict[int, int] = {s: 0 for s in freqs}
+    tick = len(heap)
+    while len(heap) > 1:
+        fa, _, sa = heapq.heappop(heap)
+        fb, _, sb = heapq.heappop(heap)
+        for s in sa + sb:
+            depth[s] += 1
+        heapq.heappush(heap, (fa + fb, tick, sa + sb))
+        tick += 1
+    return depth
+
+
+def canonical_assign(syms, lens) -> Dict[int, Tuple[int, int]]:
+    """Canonical code assignment ordered by (length, value) — the
+    htsjdk CanonicalHuffmanIntegerCodec convention. Returns
+    sym -> (code, len)."""
+    pairs = sorted(zip(lens, syms))
+    codes: Dict[int, Tuple[int, int]] = {}
+    code = 0
+    prev_len = 0
+    for ln, s in pairs:
+        code <<= (ln - prev_len)
+        codes[s] = (code, ln)
+        code += 1
+        prev_len = ln
+    return codes
+
+
+def _gamma_write(bw: BitWriter, value: int, offset: int) -> None:
+    v = value + offset
+    assert v >= 1, "gamma codes require value + offset >= 1"
+    nb = v.bit_length() - 1
+    bw.write(0, nb)
+    bw.write(v, nb + 1)
+
+
+def _gamma_read(bc: BitCursor, offset: int) -> int:
+    z = 0
+    while bc.bit() == 0:
+        z += 1
+    v = (1 << z) | bc.bits(z)
+    return v - offset
+
+
+def _subexp_write(bw: BitWriter, value: int, offset: int, k: int) -> None:
+    v = value + offset
+    if v < (1 << k):
+        bw.write(0, 1)
+        bw.write(v, k)
+    else:
+        b = v.bit_length() - 1
+        u = b - k + 1
+        bw.write((1 << u) - 1, u)
+        bw.write(0, 1)
+        bw.write(v & ((1 << b) - 1), b)   # top bit implicit
+
+
+def _subexp_read(bc: BitCursor, offset: int, k: int) -> int:
+    u = 0
+    while bc.bit() == 1:
+        u += 1
+    if u == 0:
+        v = bc.bits(k)
+    else:
+        b = k + u - 1
+        v = (1 << b) | bc.bits(b)
+    return v - offset
+
+
+def _enc_raw(codec: int, params: bytes) -> bytes:
+    return write_itf8(codec) + write_itf8(len(params)) + params
+
+
+def enc_bytes_beta(offset: int, nbits: int) -> bytes:
+    return _enc_raw(E_BETA, write_itf8(offset) + write_itf8(nbits))
+
+
+def enc_bytes_gamma(offset: int) -> bytes:
+    return _enc_raw(E_GAMMA, write_itf8(offset))
+
+
+def enc_bytes_subexp(offset: int, k: int) -> bytes:
+    return _enc_raw(E_SUBEXP, write_itf8(offset) + write_itf8(k))
+
+
+def enc_bytes_huffman(syms, lens) -> bytes:
+    p = write_itf8(len(syms)) + b"".join(write_itf8(s) for s in syms)
+    p += write_itf8(len(lens)) + b"".join(write_itf8(x) for x in lens)
+    return _enc_raw(E_HUFFMAN, p)
 
 
 @dataclass
@@ -159,6 +318,9 @@ class CompressionHeader:
     tag_lines: List[List[int]] = field(default_factory=list)  # TD
     series_enc: Dict[str, Encoding] = field(default_factory=dict)
     tag_enc: Dict[int, Encoding] = field(default_factory=dict)
+    # encode-side: raw encoding bytes overriding the default EXTERNAL
+    # wiring for a series (core bit codecs)
+    enc_overrides: Dict[str, bytes] = field(default_factory=dict)
 
     def to_bytes(self) -> bytes:
         # preservation map
@@ -183,7 +345,9 @@ class CompressionHeader:
         for name in SERIES:
             if name in ("BB_LEN", "BB_VAL"):
                 continue
-            if name == "RN":
+            if name in self.enc_overrides:
+                enc = self.enc_overrides[name]
+            elif name == "RN":
                 enc = _enc_byte_array_stop(0, CID["RN"])
             elif name in ("IN", "SC"):
                 enc = _enc_byte_array_stop(0, CID[name])
@@ -272,10 +436,24 @@ class _Streams:
 
 
 class _Readers:
-    """Per-content-id cursors (decode side)."""
+    """Per-content-id cursors + CORE bit cursor (decode side)."""
 
-    def __init__(self, blocks: Dict[int, bytes]):
+    def __init__(self, blocks: Dict[int, bytes], core: bytes = b""):
         self.cur = {cid: Cursor(data) for cid, data in blocks.items()}
+        self.core = BitCursor(core or b"")
+        self._huff_cache: Dict[int, object] = {}
+
+    def _huffman(self, enc: Encoding):
+        key = id(enc)
+        tbl = self._huff_cache.get(key)
+        if tbl is None:
+            syms, lens = enc.params
+            codes = canonical_assign(syms, lens)
+            # decode walk tables: (len -> first code, offset) + sorted syms
+            by = sorted((ln, c, s) for s, (c, ln) in codes.items())
+            tbl = by
+            self._huff_cache[key] = tbl
+        return tbl
 
     def _c(self, cid: int) -> Cursor:
         try:
@@ -286,15 +464,40 @@ class _Readers:
     def read_int(self, enc: Encoding) -> int:
         if enc.codec == E_EXTERNAL:
             return self._c(enc.params).itf8()
-        if enc.codec == E_HUFFMAN and len(enc.params[0]) == 1:
-            return enc.params[0][0]  # zero-bit constant (htsjdk idiom)
+        if enc.codec == E_HUFFMAN:
+            if len(enc.params[0]) == 1:
+                return enc.params[0][0]  # zero-bit constant (htsjdk idiom)
+            return self._read_huffman(enc)
+        if enc.codec == E_BETA:
+            offset, nbits = enc.params
+            return self.core.bits(nbits) - offset
+        if enc.codec == E_GAMMA:
+            return _gamma_read(self.core, enc.params)
+        if enc.codec == E_SUBEXP:
+            offset, k = enc.params
+            return _subexp_read(self.core, offset, k)
         raise ValueError(f"unsupported int encoding codec {enc.codec}")
+
+    def _read_huffman(self, enc: Encoding) -> int:
+        by = self._huffman(enc)   # sorted (len, code, sym)
+        code = 0
+        ln = 0
+        i = 0
+        while i < len(by):
+            want_len = by[i][0]
+            code = (code << (want_len - ln)) | self.core.bits(want_len - ln)
+            ln = want_len
+            while i < len(by) and by[i][0] == ln:
+                if by[i][1] == code:
+                    return by[i][2]
+                i += 1
+        raise ValueError("invalid canonical Huffman code in CORE stream")
 
     def read_byte(self, enc: Encoding) -> int:
         if enc.codec == E_EXTERNAL:
             return self._c(enc.params).u8()
-        if enc.codec == E_HUFFMAN and len(enc.params[0]) == 1:
-            return enc.params[0][0]
+        if enc.codec in (E_HUFFMAN, E_BETA, E_GAMMA, E_SUBEXP):
+            return self.read_int(enc)
         raise ValueError(f"unsupported byte encoding codec {enc.codec}")
 
     def read_bytes_len(self, enc: Encoding, n: int) -> bytes:
@@ -327,23 +530,53 @@ def _seq_chars(batch: ReadBatch, i: int) -> np.ndarray:
     return _NT16_BYTES[batch.seqs[s:e]]
 
 
+def _qs_order1() -> bool:
+    from disq_tpu.runtime.debug import env_flag
+
+    return env_flag("DISQ_TPU_CRAM_RANS_O1")
+
+
 def encode_container(
     batch: ReadBatch,
     refid: int,
     record_counter: int,
     ref_fetch=None,
+    core_profile: Optional[bool] = None,
 ) -> Tuple[bytes, dict]:
     """Encode one single-ref slice (all records share ``refid``) into a
     complete container. ``ref_fetch(refid, start0, length) -> bytes``
     enables reference-based M-run omission. Returns (container bytes,
-    crai entry info dict)."""
+    crai entry info dict).
+
+    ``core_profile`` (default: the ``DISQ_TPU_CRAM_CORE`` env flag)
+    routes CF through a canonical core Huffman code, MQ through
+    BETA(0,8) and FN through GAMMA(1) — the CORE-block bit codecs
+    foreign htsjdk/samtools CRAMs use, exercised end-to-end."""
     from disq_tpu.cram.structure import (
         Block, COMPRESSION_HEADER, CORE, ContainerHeader, EXTERNAL,
         GZIP, MAPPED_SLICE, RANS, RAW, SliceHeader,
     )
 
+    if core_profile is None:
+        from disq_tpu.runtime.debug import env_flag
+
+        core_profile = env_flag("DISQ_TPU_CRAM_CORE")
     n = batch.count
     streams = _Streams()
+    bw = BitWriter()
+    cf_codes = None
+    # one CF formula for both the huffman pre-pass and the encode loop
+    seq_lens = np.diff(batch.seq_offsets)
+    cf_vals = (CF_QS_STORED | CF_DETACHED
+               | np.where(seq_lens == 0, CF_UNKNOWN_BASES, 0)).astype(int)
+    if core_profile:
+        freq: Dict[int, int] = {}
+        for v in cf_vals.tolist():
+            freq[v] = freq.get(v, 0) + 1
+        lens_map = huffman_code_lengths(freq) if freq else {}
+        cf_syms = sorted(lens_map)
+        cf_lens = [lens_map[s] for s in cf_syms]
+        cf_codes = canonical_assign(cf_syms, cf_lens)
     tag_line_index: Dict[tuple, int] = {}
     tag_lines: List[List[int]] = []
     total_bases = 0
@@ -360,9 +593,13 @@ def encode_container(
                 "CRAM profile limitation: record with CIGAR but no "
                 "sequence bases is not representable via read features"
             )
-        cf = CF_QS_STORED | CF_DETACHED | (CF_UNKNOWN_BASES if l_seq == 0 else 0)
+        cf = int(cf_vals[i])
         streams.put_itf8(CID["BF"], flag)
-        streams.put_itf8(CID["CF"], cf)
+        if cf_codes is not None:
+            code, nb = cf_codes[cf]
+            bw.write(code, nb)
+        else:
+            streams.put_itf8(CID["CF"], cf)
         streams.put_itf8(CID["RL"], l_seq)
         streams.put_itf8(CID["AP"], int(batch.pos[i]) + 1)
         streams.put_itf8(CID["RG"], -1)
@@ -387,10 +624,6 @@ def encode_container(
             cid = TAG_CID_BASE + key
             streams.put_itf8(cid, len(val))
             streams.put_bytes(cid, val)
-        streams.put_itf8(CID["MQ"], int(batch.mapq[i]))
-        # qualities (always stored)
-        q = batch.quals[batch.seq_offsets[i]:batch.seq_offsets[i + 1]]
-        streams.put_bytes(CID["QS"], q.tobytes())
         total_bases += l_seq
 
         # read features from CIGAR + seq (vs reference)
@@ -443,7 +676,10 @@ def encode_container(
             # Bases not covered by CIGAR (typically unmapped records with
             # no CIGAR at all): embed them verbatim.
             features.append((rp, "b", seq[rp - 1:].tobytes()))
-        streams.put_itf8(CID["FN"], len(features))
+        if core_profile:
+            _gamma_write(bw, len(features), 1)   # GAMMA(offset=1)
+        else:
+            streams.put_itf8(CID["FN"], len(features))
         prev = 0
         for fpos, code, payload in features:
             streams.put_bytes(CID["FC"], code.encode())
@@ -462,11 +698,25 @@ def encode_container(
                 streams.put_itf8(CID["HC"], payload)
             elif code == "P":
                 streams.put_itf8(CID["PD"], payload)
+        # MQ + QS come AFTER the read-feature list (CRAM 3.0 record
+        # layout; htsjdk CramRecordReader) — load-bearing once any of
+        # these series shares the CORE bit stream
+        if core_profile:
+            bw.write(int(batch.mapq[i]), 8)      # BETA(0, 8)
+        else:
+            streams.put_itf8(CID["MQ"], int(batch.mapq[i]))
+        q = batch.quals[batch.seq_offsets[i]:batch.seq_offsets[i + 1]]
+        streams.put_bytes(CID["QS"], q.tobytes())
 
     comp_header = CompressionHeader(
         rn_preserved=True, ap_delta=False,
         ref_required=any_ref_omitted, tag_lines=tag_lines or [[]],
     )
+    if core_profile:
+        comp_header.enc_overrides["CF"] = enc_bytes_huffman(
+            cf_syms, cf_lens)
+        comp_header.enc_overrides["MQ"] = enc_bytes_beta(0, 8)
+        comp_header.enc_overrides["FN"] = enc_bytes_gamma(1)
     ch_block = Block(COMPRESSION_HEADER, 0, comp_header.to_bytes(), GZIP)
 
     # slice bounds
@@ -482,9 +732,14 @@ def encode_container(
     for cid in sorted(streams.data):
         payload = bytes(streams.data[cid])
         method = RANS if cid == CID["QS"] else GZIP
-        ext_blocks.append(Block(EXTERNAL, cid, payload, method))
+        # QS order-1 (context = previous qual, htslib's QS default,
+        # typically 10-20% smaller) is opt-in: the encoder is pure
+        # Python until a native port lands, so order-0 (native-
+        # accelerated) stays the production default
+        order = 1 if (cid == CID["QS"] and _qs_order1()) else 0
+        ext_blocks.append(Block(EXTERNAL, cid, payload, method, order))
         content_ids.append(cid)
-    core_block = Block(CORE, 0, b"", RAW)
+    core_block = Block(CORE, 0, bw.flush() if core_profile else b"", RAW)
     slice_hdr = SliceHeader(
         ref_seq_id=refid, ref_start=ref_start, ref_span=ref_span,
         n_records=n, record_counter=record_counter,
@@ -543,14 +798,15 @@ def decode_container_records(
                 blocks[b.content_id] = b.data
             elif b.content_type == CORE:
                 core = b.data
-        batches.append(_decode_slice(slice_hdr, comp, blocks, ref_fetch))
+        batches.append(_decode_slice(slice_hdr, comp, blocks, core, ref_fetch))
     return ReadBatch.concat(batches)
 
 
 def _decode_slice(
-    slice_hdr, comp: CompressionHeader, blocks: Dict[int, bytes], ref_fetch
+    slice_hdr, comp: CompressionHeader, blocks: Dict[int, bytes], core,
+    ref_fetch,
 ) -> ReadBatch:
-    rd = _Readers(blocks)
+    rd = _Readers(blocks, core or b"")
     enc = comp.series_enc
     n = slice_hdr.n_records
     refid = slice_hdr.ref_seq_id
@@ -589,8 +845,7 @@ def _decode_slice(
         for key in comp.tag_lines[tl]:
             val = rd.read_array(comp.tag_enc[key])
             tag_entries.append((key, val))
-        mq = rd.read_int(enc["MQ"])
-        # features
+        # features (MQ follows them — CRAM 3.0 record layout)
         fn = rd.read_int(enc["FN"])
         features = []
         fpos = 0
@@ -614,6 +869,7 @@ def _decode_slice(
             else:
                 raise ValueError(f"unsupported read feature {code!r}")
             features.append((fpos, code, payload))
+        mq = rd.read_int(enc["MQ"])
         quals = rd.read_bytes_len(enc["QS"], rl) if cf & CF_QS_STORED else b"\xff" * rl
 
         # reconstruct seq + cigar

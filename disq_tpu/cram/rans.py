@@ -9,8 +9,10 @@ layout (matching htslib's rANS_static):
 Constants: 12-bit frequency precision (sum 4096), lower bound 1<<23,
 byte-wise renormalization, 4 states round-robin over output positions.
 
-Order-0 is implemented for both encode and decode (what our CRAM writer
-emits); order-1 decode is implemented for reading foreign files.
+Order 0 and order 1 are both implemented for encode and decode. The
+writer emits order-0 by default (native-accelerated) and order-1 for
+quality scores when ``DISQ_TPU_CRAM_RANS_O1`` is set (the htslib QS
+default; the Python encoder is exact but unaccelerated).
 """
 
 from __future__ import annotations
@@ -147,6 +149,87 @@ def rans_encode_order0(raw: bytes) -> bytes:
     payload += bytes(reversed(out_rev))
     body = table + payload
     return struct.pack("<BII", 0, len(body), n) + body
+
+
+# -- order-1 encode ---------------------------------------------------------
+
+def rans_encode_order1(raw: bytes) -> bytes:
+    """Order-1 rANS 4x8 (htslib wire format): 4 interleaved states, each
+    encoding a contiguous quarter with the previous byte as context
+    (context 0 at each quarter start). Exact inverse of ``_decode1`` —
+    the decode loop pops renorm bytes round-robin per position, so the
+    encoder walks that schedule in reverse.
+
+    Reference behavior: htsjdk/htslib rANS order-1 (SURVEY.md §2.8 CRAM
+    row; VERDICT r4 item 7)."""
+    data = np.frombuffer(raw, dtype=np.uint8)
+    n = len(data)
+    if n == 0:
+        return struct.pack("<BII", 1, 0, 0)
+    q = n // 4
+    starts = [0, q, 2 * q, 3 * q]
+    ends = [q, 2 * q, 3 * q, n]
+
+    # per-context symbol counts (context = previous byte in the quarter,
+    # 0 at quarter start)
+    counts = np.zeros((256, 256), dtype=np.int64)
+    for j in range(4):
+        s, e = starts[j], ends[j]
+        if e > s:
+            seg = data[s:e]
+            prev = np.concatenate([[np.uint8(0)], seg[:-1]])
+            np.add.at(counts, (prev, seg), 1)
+    present = np.flatnonzero(counts.sum(axis=1) > 0)
+    freqs = np.zeros((256, 256), dtype=np.int64)
+    for c in present:
+        freqs[c] = _normalize_freqs(counts[c])
+    cum = np.zeros((256, 257), dtype=np.int64)
+    np.cumsum(freqs, axis=1, out=cum[:, 1:])
+
+    # context table header mirroring _decode1's RLE-over-contexts parse
+    table = bytearray()
+    i = 0
+    plist = [int(c) for c in present]
+    while i < len(plist):
+        run = 1
+        while (i + run < len(plist)
+               and plist[i + run] == plist[i] + run):
+            run += 1
+        table.append(plist[i])
+        table += _write_freq_table0(freqs[plist[i]])
+        if run > 1:
+            # parser: nxt == last+1 -> read rle count, then auto-advance
+            table.append(plist[i] + 1)
+            table.append(run - 2)
+            for k in range(1, run):
+                table += _write_freq_table0(freqs[plist[i] + k])
+        i += run
+    table.append(0)  # terminator
+
+    # encode: reverse of the decode schedule. Decode pops (k, j) in
+    # order k=0..: j=0..3 (j active while k < len_j); we push reversed.
+    lens = [ends[j] - starts[j] for j in range(4)]
+    kmax = max(lens)
+    states = [RANS_LOW] * 4
+    out_rev = bytearray()
+    for k in range(kmax - 1, -1, -1):
+        for j in (3, 2, 1, 0):
+            if k >= lens[j]:
+                continue
+            p = starts[j] + k
+            s = int(data[p])
+            c = 0 if k == 0 else int(data[p - 1])
+            x = states[j]
+            f = int(freqs[c][s])
+            x_max = ((RANS_LOW >> TF_SHIFT) << 8) * f
+            while x >= x_max:
+                out_rev.append(x & 0xFF)
+                x >>= 8
+            states[j] = ((x // f) << TF_SHIFT) + (x % f) + int(cum[c][s])
+    payload = b"".join(struct.pack("<I", states[j]) for j in range(4))
+    payload += bytes(reversed(out_rev))
+    body = bytes(table) + payload
+    return struct.pack("<BII", 1, len(body), n) + body
 
 
 # -- decode (order 0 and 1) -------------------------------------------------

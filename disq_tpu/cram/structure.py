@@ -59,6 +59,7 @@ class Block:
     content_id: int
     data: bytes                  # raw (uncompressed) content
     method: int = RAW            # method to use when serializing
+    rans_order: int = 0          # RANS method: 0 or 1 (order-1 for QS)
 
     def to_bytes(self) -> bytes:
         if self.method == RAW:
@@ -66,7 +67,12 @@ class Block:
         elif self.method == GZIP:
             comp = _gzip.compress(self.data, compresslevel=6, mtime=0)
         elif self.method == RANS:
-            comp = rans_encode_order0(self.data)
+            if self.rans_order == 1:
+                from disq_tpu.cram.rans import rans_encode_order1
+
+                comp = rans_encode_order1(self.data)
+            else:
+                comp = rans_encode_order0(self.data)
         else:
             raise ValueError(f"unsupported write method {self.method}")
         body = (

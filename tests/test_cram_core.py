@@ -1,0 +1,230 @@
+"""CRAM CORE-block bit codecs + rANS order-1 encode (VERDICT r4 item 7).
+
+Foreign htsjdk/samtools CRAMs route data series through CORE-block bit
+codecs — canonical Huffman, BETA, GAMMA, SUBEXP — which the reader now
+decodes. Spec-exact worked examples pin the bit-level formats; the
+core-profile writer (CF→Huffman, MQ→BETA, FN→GAMMA) gives true
+round-trip coverage through the whole container path. The rANS order-1
+encoder is verified against BOTH the independent Python decoder and
+the native C decoder.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from disq_tpu.cram.codec import (
+    BitCursor,
+    BitWriter,
+    _gamma_read,
+    _gamma_write,
+    _subexp_read,
+    _subexp_write,
+    canonical_assign,
+    huffman_code_lengths,
+)
+from disq_tpu.cram.rans import _decode1, rans_decode, rans_encode_order1
+
+
+class TestBitCodecsWorkedExamples:
+    """Hand-computed bit patterns per the CRAM 3.0 codec definitions."""
+
+    def test_beta_bits(self):
+        # BETA(offset=0, nbits=4): 5 -> 0101; 12 -> 1100
+        bw = BitWriter()
+        bw.write(5, 4)
+        bw.write(12, 4)
+        assert bw.flush() == bytes([0b0101_1100])
+
+    def test_gamma_worked_example(self):
+        # Elias gamma of v=5 (offset 0): 2 zeros + '101' -> 00101
+        bw = BitWriter()
+        _gamma_write(bw, 5, 0)
+        data = bw.flush()
+        assert data == bytes([0b00101_000])
+        assert _gamma_read(BitCursor(data), 0) == 5
+
+    def test_gamma_offset_allows_zero(self):
+        bw = BitWriter()
+        _gamma_write(bw, 0, 1)  # v = 1 -> single '1' bit
+        data = bw.flush()
+        assert data == bytes([0b1000_0000])
+        assert _gamma_read(BitCursor(data), 1) == 0
+
+    def test_subexp_worked_example(self):
+        # SUBEXP(offset=0, k=2), value 5: b=2, u=1 -> '1','0', then
+        # b=k+u-1=2 low bits of 5 (0b101 minus implicit top) = '01'
+        bw = BitWriter()
+        _subexp_write(bw, 5, 0, 2)
+        data = bw.flush()
+        assert data == bytes([0b1001_0000])
+        assert _subexp_read(BitCursor(data), 0, 2) == 5
+
+    def test_subexp_small_value(self):
+        # value 2 < 2^k: '0' then 2 in k=2 bits -> 010
+        bw = BitWriter()
+        _subexp_write(bw, 2, 0, 2)
+        data = bw.flush()
+        assert data == bytes([0b0100_0000])
+        assert _subexp_read(BitCursor(data), 0, 2) == 2
+
+    @pytest.mark.parametrize("codec", ["beta", "gamma", "subexp"])
+    def test_round_trip_sweep(self, codec):
+        rng = np.random.default_rng(1)
+        vals = rng.integers(0, 1 << 16, 500).tolist()
+        bw = BitWriter()
+        for v in vals:
+            if codec == "beta":
+                bw.write(v, 17)
+            elif codec == "gamma":
+                _gamma_write(bw, v, 1)
+            else:
+                _subexp_write(bw, v, 0, 3)
+        bc = BitCursor(bw.flush())
+        for v in vals:
+            if codec == "beta":
+                assert bc.bits(17) == v
+            elif codec == "gamma":
+                assert _gamma_read(bc, 1) == v
+            else:
+                assert _subexp_read(bc, 0, 3) == v
+
+    def test_canonical_huffman_assignment(self):
+        # lengths {A:1, B:2, C:2} with values A=0,B=1,C=2 ->
+        # canonical codes: 0, 10, 11
+        codes = canonical_assign([0, 1, 2], [1, 2, 2])
+        assert codes == {0: (0b0, 1), 1: (0b10, 2), 2: (0b11, 2)}
+
+    def test_huffman_lengths_kraft(self):
+        freqs = {i: f for i, f in enumerate([50, 20, 15, 10, 5])}
+        lens = huffman_code_lengths(freqs)
+        assert sum(2.0 ** -l for l in lens.values()) <= 1.0 + 1e-9
+        assert lens[0] <= lens[4]
+
+
+class TestCoreProfileRoundTrip:
+    """CF/MQ/FN through CORE bit codecs, end-to-end through the
+    container writer and back through the reader."""
+
+    def _batch(self, n=300, seed=3):
+        from tests.bam_oracle import synth_records
+        from tests.test_bam_codec import _blob
+        from disq_tpu.bam import decode_records
+
+        return decode_records(_blob(synth_records(n, seed=seed)))
+
+    def test_container_round_trip(self):
+        from disq_tpu.cram.codec import (
+            decode_container_records, encode_container,
+        )
+        from disq_tpu.cram.structure import ContainerHeader
+        from disq_tpu.cram.io import Cursor
+
+        batch = self._batch()
+        one = batch.take(np.flatnonzero(np.asarray(batch.refid) == 0))
+        blob, _info = encode_container(one, 0, 0, core_profile=True)
+        cur = Cursor(blob)
+        ContainerHeader.read(cur)  # skip the container header
+        back = decode_container_records(bytes(blob[cur.off:]))
+        for col in ("refid", "pos", "mapq", "flag", "names", "seqs",
+                    "quals", "cigars", "tags"):
+            np.testing.assert_array_equal(
+                getattr(back, col), getattr(one, col), err_msg=col)
+
+    def test_storage_round_trip_with_core_flag(self, tmp_path, monkeypatch):
+        from disq_tpu.api import ReadsStorage
+        from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+        src = tmp_path / "in.bam"
+        src.write_bytes(
+            make_bam_bytes(DEFAULT_REFS,
+                           synth_records(400, seed=5, sorted_coord=True)))
+        ds = ReadsStorage.make_default().read(str(src))
+        out = tmp_path / "o.cram"
+        monkeypatch.setenv("DISQ_TPU_CRAM_CORE", "1")
+        ReadsStorage.make_default().write(ds, str(out))
+        monkeypatch.delenv("DISQ_TPU_CRAM_CORE")
+        back = ReadsStorage.make_default().read(str(out))
+        assert back.count() == 400
+        np.testing.assert_array_equal(back.reads.mapq, ds.reads.mapq)
+        np.testing.assert_array_equal(back.reads.flag, ds.reads.flag)
+        np.testing.assert_array_equal(back.reads.seqs, ds.reads.seqs)
+        np.testing.assert_array_equal(back.reads.quals, ds.reads.quals)
+
+
+class TestRansOrder1:
+    CASES = None
+
+    def _cases(self):
+        rng = np.random.default_rng(0)
+        return [
+            b"", b"a", b"ab", b"abc", b"abcd",
+            bytes(rng.integers(30, 45, 5000, dtype=np.uint8)),
+            np.repeat(rng.integers(30, 45, 500, dtype=np.uint8),
+                      17).tobytes(),
+            bytes(rng.integers(0, 256, 3000, dtype=np.uint8)),
+            b"ACGT" * 2000,
+        ]
+
+    def test_round_trip_python_decoder(self):
+        for raw in self._cases():
+            enc = rans_encode_order1(raw)
+            order, csize, rsize = struct.unpack_from("<BII", enc, 0)
+            assert order == 1
+            got = _decode1(memoryview(enc)[9:9 + csize], rsize) if rsize \
+                else b""
+            assert got == raw
+
+    def test_round_trip_native_decoder(self):
+        try:
+            from disq_tpu.native import rans_decode_native
+        except ImportError:
+            pytest.skip("native codec not built")
+        for raw in self._cases():
+            if raw:
+                assert rans_decode_native(rans_encode_order1(raw)) == raw
+
+    def test_order1_beats_order0_on_qualities(self):
+        from disq_tpu.cram.rans import rans_encode_order0
+
+        rng = np.random.default_rng(7)
+        # markov-ish quality track: strong prev-byte correlation
+        steps = rng.integers(-2, 3, 20000)
+        quals = np.clip(33 + np.cumsum(steps) % 8, 33, 41).astype(np.uint8)
+        raw = quals.tobytes()
+        assert len(rans_encode_order1(raw)) < len(rans_encode_order0(raw))
+
+    def test_storage_round_trip_order1_flag(self, tmp_path, monkeypatch):
+        from disq_tpu.api import ReadsStorage
+        from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+        src = tmp_path / "i.bam"
+        src.write_bytes(make_bam_bytes(
+            DEFAULT_REFS, synth_records(150, seed=12, sorted_coord=True)))
+        ds = ReadsStorage.make_default().read(str(src))
+        out = tmp_path / "o1.cram"
+        monkeypatch.setenv("DISQ_TPU_CRAM_RANS_O1", "1")
+        ReadsStorage.make_default().write(ds, str(out))
+        monkeypatch.delenv("DISQ_TPU_CRAM_RANS_O1")
+        back = ReadsStorage.make_default().read(str(out))
+        np.testing.assert_array_equal(back.reads.quals, ds.reads.quals)
+
+    def test_qs_blocks_written_order1(self, tmp_path):
+        from disq_tpu.cram.codec import CID, encode_container
+        from disq_tpu.cram.structure import Block, EXTERNAL
+        from disq_tpu.cram.io import Cursor
+
+        batch = TestCoreProfileRoundTrip()._batch(100, seed=9)
+        one = batch.take(np.flatnonzero(np.asarray(batch.refid) == 0))
+        blob, _ = encode_container(one, 0, 0)
+        from disq_tpu.cram.structure import ContainerHeader
+
+        cur = Cursor(blob)
+        ContainerHeader.read(cur)  # skip the container header
+        found = None
+        while cur.off < len(blob):
+            b = Block.read(cur)
+            if b.content_type == EXTERNAL and b.content_id == CID["QS"]:
+                found = b
+        assert found is not None and len(found.data) > 0
