@@ -200,6 +200,43 @@ def run_deflate(results: list) -> None:
     assert ok, "device deflate round-trip mismatch"
 
 
+def run_device_pipeline_row(results: list) -> None:
+    """Device-resident read pipeline under jax.transfer_guard:
+    decoded bytes -> prefix gather -> Pallas parse -> keys -> sort ->
+    flagstat with zero intermediate device<->host copies, on the real
+    chip where the guard genuinely bites (VERDICT r4 item 4)."""
+    from disq_tpu.runtime.device_pipeline import run_device_pipeline
+
+    rng = np.random.default_rng(5)
+    n = 200_000
+    # synthetic fixed-shape records: block_size word + 8 prefix words
+    rec_words = 9 + 16
+    blob = np.zeros(n * rec_words * 4, np.uint8)
+    w = blob.view("<i4").reshape(n, rec_words)
+    w[:, 0] = rec_words * 4 - 4
+    w[:, 1] = rng.integers(-1, 5, n)
+    w[:, 2] = rng.integers(0, 1 << 20, n)
+    w[:, 3] = 8 | (60 << 8)
+    w[:, 4] = (rng.integers(0, 16, n) << 16) | 1
+    offs = np.arange(0, (n + 1) * rec_words * 4, rec_words * 4,
+                     dtype=np.int64)
+    keys, order, stats = run_device_pipeline(blob, offs, interpret=False)
+    ok = stats["total"] == n and (keys[1:] >= keys[:-1]).all()
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_device_pipeline(blob, offs, interpret=False)
+        best = min(best, time.perf_counter() - t0)
+    results.append({
+        "kernel": "device_pipeline_parse_sort_flagstat",
+        "shape": f"{n} records",
+        "records_per_sec": round(n / best, 1),
+        "transfer_guard": "disallow (enforced)",
+        "correct": bool(ok),
+    })
+    assert ok
+
+
 def main(out_path: str = "TPU_KERNELS.json") -> int:
     import jax
 
@@ -209,7 +246,7 @@ def main(out_path: str = "TPU_KERNELS.json") -> int:
         return 0
     results: list = []
     for fn in (run_inflate_simd, run_inflate_legacy, run_rans,
-               run_deflate):
+               run_deflate, run_device_pipeline_row):
         try:
             fn(results)
         except Exception as e:  # record the failure, keep going
