@@ -66,9 +66,10 @@ from disq_tpu.ops.inflate import (
 
 LANES = 128
 _MAXLENS = 320          # 288 lit/len + 32 dist code lengths
+_SLAB = 2048            # slab rows for big-buffer one-hot ops (VMEM temps)
 RING_W = 1024           # history ring: last 4 KiB per lane, word rows
 RING_SAFE = 4096 - 8    # max distance served by the ring
-MAX_DEVICE_CSIZE = 4096 * 4 - 16  # comp cap; bigger payloads -> host
+MAX_DEVICE_CSIZE = 8192 * 4 - 16  # comp cap; bigger payloads -> host
 _U32 = jnp.uint32
 _I32 = jnp.int32
 
@@ -109,6 +110,22 @@ _FSYM_D_PAD[: len(_FSYM_D)] = _FSYM_D
 
 def _riota(rows: int) -> jnp.ndarray:
     return lax.broadcasted_iota(_I32, (rows, LANES), 0)
+
+
+def _gather_ref(ref, rows, slab: int = _SLAB):
+    """One-hot row gather reading a (possibly large) REF slab-wise so no
+    full-buffer temporary materializes (scoped-vmem stack is ~16 MB
+    minus persistent buffers). OR-merge works because exactly one slab
+    contains each lane's row and misses contribute zero."""
+    r = ref.shape[0]
+    if r <= slab:
+        return _gather(ref[...], rows)
+    acc = None
+    for s in range(0, r, slab):
+        sl = min(slab, r - s)
+        g = _gather(ref[s:s + sl, :], rows - s)
+        acc = g if acc is None else acc | g
+    return acc
 
 
 def _gather(data, rows):
@@ -270,7 +287,11 @@ def _inflate_simd_kernel(
 ):
     zrow = jnp.zeros((1, LANES), _I32)
     zrow_u = jnp.zeros((1, LANES), _U32)
-    out_ref[...] = jnp.zeros((ow, LANES), _U32)
+    # slab-wise init + RMW below keep peak scoped-vmem temps ~1 MB so
+    # comp (8192,128) fits alongside out (16384,128)
+    for _s in range(0, ow, _SLAB):
+        _sl = min(_SLAB, ow - _s)
+        out_ref[_s:_s + _sl, :] = jnp.zeros((_sl, LANES), _U32)
     for ref in (symlit_ref, symdist_ref, symcl_ref, lens_ref, cl_lens_ref):
         ref[...] = jnp.zeros(ref.shape, ref.dtype)
     for ref in (cntl_ref, firstl_ref, offl_ref, cursl_ref,
@@ -288,7 +309,7 @@ def _inflate_simd_kernel(
     # pre-phase-B refill restores >= 33, dist code <= 15 leaves >= 18
     # >= 13 extra bits. No unaligned double-gather assembly.
     def refill64(lo, hi, cnt, in_w):
-        w = _gather(comp_ref[...], jnp.minimum(in_w, cw - 1)).astype(_U32)
+        w = _gather_ref(comp_ref, jnp.minimum(in_w, cw - 1)).astype(_U32)
         do = cnt <= 32
         cu = jnp.minimum(cnt, 31).astype(_U32)
         lo = jnp.where(do & (cnt < 32), lo | (w << cu), lo)
@@ -557,11 +578,9 @@ def _inflate_simd_kernel(
         far = m & (d > RING_SAFE)
 
         def far_fetch():
-            f0 = _gather(out_ref[...],
-                         jnp.where(far, jnp.minimum(bw, ow - 1), -1))
-            f1 = _gather(out_ref[...],
-                         jnp.where(far, jnp.minimum(bw + 1, ow - 1), -1))
-            return f0, f1
+            r0 = jnp.where(far, jnp.minimum(bw, ow - 1), -1)
+            r1 = jnp.where(far, jnp.minimum(bw + 1, ow - 1), -1)
+            return _gather_ref(out_ref, r0), _gather_ref(out_ref, r1)
 
         fw0, fw1 = lax.cond(
             jnp.any(far), far_fetch, lambda: (zrow_u, zrow_u))
@@ -595,10 +614,14 @@ def _inflate_simd_kernel(
         kmask = _mask_bits(emit_k << 3)
         bits = (packed & kmask) << ((off << 3).astype(_U32))
         # big out: bytes land exactly once, buffer starts zeroed -> OR;
-        # mask folded into the row (-1 matches nothing): pure one-hot
+        # mask folded into the row (-1 matches nothing): pure one-hot,
+        # slab-wise to bound scoped-vmem temps
         wrow = jnp.where(emitting, outpos >> 2, -1)
-        cur = out_ref[...]
-        out_ref[...] = jnp.where(_riota(ow) == wrow, cur | bits, cur)
+        for s in range(0, ow, _SLAB):
+            sl = min(_SLAB, ow - s)
+            cur = out_ref[s:s + sl, :]
+            out_ref[s:s + sl, :] = jnp.where(
+                _riota(sl) == wrow - s, cur | bits, cur)
         # history ring: same word, replace-semantics (rows recycle)
         rrow = jnp.where(emitting, (outpos >> 2) & (RING_W - 1), -1)
         curr = ring_ref[...]
@@ -641,7 +664,10 @@ def _inflate_simd_kernel(
 
 @functools.lru_cache(maxsize=8)
 def _compiled(cw: int, ow: int, interpret: bool):
-    max_steps = 2 * ow * 4 + 8192
+    # emits bound one term; non-emitting supersteps (headers, table
+    # builds, dist phases) consume >= 3 input bits each, so cw bounds
+    # the other — flush-heavy many-small-block streams stay on device
+    max_steps = 2 * ow * 4 + 2 * cw * 4 + 8192
     kernel = functools.partial(
         _inflate_simd_kernel, cw=cw, ow=ow, max_steps=max_steps)
     t16 = pltpu.VMEM((16, LANES), _U32)
@@ -719,11 +745,10 @@ def inflate_payloads_simd(
         interpret = jax.default_backend() != "tpu"
     if not payloads:
         return []
-    # VMEM budget (~16 MB/core): with out (16384,128) u32 = 8 MB the
-    # largest comp buffer Mosaic will still allocate is (4096,128) u32 =
-    # 4 MB (cw 8192 exceeds the scoped-vmem limit at compile). Payloads
-    # over the comp cap go to host zlib; the segmented-output layout
-    # lifts this to 32 KiB.
+    # VMEM budget (~16 MB/core): comp (8192,128) u32 = 4 MB + out
+    # (16384,128) u32 = 8 MB + tables/ring ~1.2 MB fits because the
+    # out-sized ops run slab-wise (2048-row temps). Payloads over the
+    # 32 KiB comp cap go to host zlib.
     max_csize = MAX_DEVICE_CSIZE
     big = [i for i, p in enumerate(payloads) if len(p) > max_csize]
     if big:
