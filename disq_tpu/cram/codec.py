@@ -10,8 +10,10 @@ Replaces htsjdk's ``CramCompressionRecord`` + ``Cram(Record)Codec`` +
   CORE bit codecs foreign htsjdk/samtools CRAMs use — full canonical
   HUFFMAN, BETA, GAMMA and SUBEXP — plus BYTE_ARRAY_STOP and
   BYTE_ARRAY_LEN, and rejects anything else with a clear error;
-- single-reference slices (ref runs split into slices), detached mate
-  info, absolute AP;
+- write side emits single-reference slices (ref runs split into
+  slices), detached mate info, absolute AP; the READ side additionally
+  handles foreign shapes: multi-reference slices (refid -2 with a
+  per-record RI series) and AP-delta coding;
 - sequence via read features: M-runs that match the reference are
   *omitted* (reference-based compression — requires the reference at
   read time, like the reference's ``CRAMReferenceSource``); mismatching
@@ -56,6 +58,7 @@ SERIES = [
     "BF", "CF", "RL", "AP", "RG", "RN", "MF", "NS", "NP", "TS", "TL",
     "MQ", "QS", "FN", "FC", "FP", "BB_LEN", "BB_VAL", "IN", "SC", "DL",
     "RS", "HC", "PD",
+    "RI",   # per-record reference id — multi-ref (refid -2) slices
 ]
 CID = {name: i + 1 for i, name in enumerate(SERIES)}
 TAG_CID_BASE = 0x10000  # tag series ids live above the fixed series
@@ -343,7 +346,12 @@ class CompressionHeader:
         # data series encodings (all EXTERNAL except byte-array series)
         entries = []
         for name in SERIES:
-            if name in ("BB_LEN", "BB_VAL"):
+            # BB_* fold into the BB byte-array encoding; RI is read-only
+            # support (our writer emits single-ref slices, so declaring
+            # an RI series with no backing block would be a dangling
+            # ref) unless a multi-ref builder overrides it explicitly
+            if name in ("BB_LEN", "BB_VAL") or (
+                    name == "RI" and "RI" not in self.enc_overrides):
                 continue
             if name in self.enc_overrides:
                 enc = self.enc_overrides[name]
@@ -810,13 +818,13 @@ def _decode_slice(
     enc = comp.series_enc
     n = slice_hdr.n_records
     refid = slice_hdr.ref_seq_id
-    if refid == -2:
+    multi_ref = refid == -2
+    if multi_ref and "RI" not in enc:
         raise ValueError(
-            "multi-reference CRAM slices (per-record RI series) are not "
-            "supported by this reader; re-encode with single-ref slices"
-        )
+            "multi-reference CRAM slice without an RI series encoding")
 
     refid_l = np.full(n, refid, np.int32)
+    prev_ap = slice_hdr.ref_start  # AP-delta seed (htsjdk convention)
     pos_l = np.empty(n, np.int32)
     mapq_l = np.empty(n, np.uint8)
     flag_l = np.empty(n, np.uint16)
@@ -830,7 +838,12 @@ def _decode_slice(
         flag = rd.read_int(enc["BF"])
         cf = rd.read_int(enc["CF"])
         rl = rd.read_int(enc["RL"])
+        if multi_ref:
+            refid_l[i] = rd.read_int(enc["RI"])
         ap = rd.read_int(enc["AP"])
+        if comp.ap_delta:
+            ap = prev_ap + ap
+            prev_ap = ap
         rd.read_int(enc["RG"])
         name = rd.read_array(enc["RN"]) if comp.rn_preserved else b""
         if cf & CF_DETACHED:
@@ -899,11 +912,11 @@ def _decode_slice(
                         "reference required to decode this CRAM slice "
                         "(set reference_source_path)"
                     )
-                rb = ref_fetch(refid, ref_pos, gap)
+                rb = ref_fetch(int(refid_l[i]), ref_pos, gap)
                 if rb is None or len(rb) < gap:
                     raise ValueError(
-                        f"reference contig for refid {refid} is missing or "
-                        f"too short in the configured FASTA"
+                        f"reference contig for refid {int(refid_l[i])} is "
+                        f"missing or too short in the configured FASTA"
                     )
                 seq[rp - 1: rp - 1 + gap] = _CHAR_TO_NT16[
                     np.frombuffer(rb.upper(), np.uint8)
@@ -933,17 +946,17 @@ def _decode_slice(
                 push(code, payload)
         tail = rl - (rp - 1)
         if tail > 0 and not (cf & CF_UNKNOWN_BASES):
-            if (flag & 0x4) == 0 and refid >= 0:
+            if (flag & 0x4) == 0 and int(refid_l[i]) >= 0:
                 if ref_fetch is None:
                     raise ValueError(
                         "reference required to decode this CRAM slice "
                         "(set reference_source_path)"
                     )
-                rb = ref_fetch(refid, ref_pos, tail)
+                rb = ref_fetch(int(refid_l[i]), ref_pos, tail)
                 if rb is None or len(rb) < tail:
                     raise ValueError(
-                        f"reference contig for refid {refid} is missing or "
-                        f"too short in the configured FASTA"
+                        f"reference contig for refid {int(refid_l[i])} is "
+                        f"missing or too short in the configured FASTA"
                     )
                 seq[rp - 1:] = _CHAR_TO_NT16[np.frombuffer(rb.upper(), np.uint8)]
                 push("M", tail)

@@ -228,3 +228,183 @@ class TestRansOrder1:
             if b.content_type == EXTERNAL and b.content_id == CID["QS"]:
                 found = b
         assert found is not None and len(found.data) > 0
+
+class TestForeignSliceShapes:
+    """Hand-built slices in shapes OUR writer never emits but foreign
+    htsjdk/samtools writers do: multi-reference (refid -2, per-record
+    RI series) and AP-delta coding."""
+
+    def _build_slice(self, recs, ap_delta):
+        """recs: list of (refid, pos0, name, seq_bytes). Returns the
+        container *block section* bytes (compression header + slice)."""
+        from disq_tpu.cram.codec import (
+            CF_DETACHED, CF_QS_STORED, CID, CompressionHeader, _Streams,
+        )
+        from disq_tpu.cram.structure import (
+            Block, COMPRESSION_HEADER, CORE, EXTERNAL, MAPPED_SLICE, RAW,
+            SliceHeader,
+        )
+
+        streams = _Streams()
+        prev_ap = 0  # slice ref_start seed
+        for refid, pos0, name, seq in recs:
+            streams.put_itf8(CID["BF"], 0)
+            streams.put_itf8(CID["CF"], CF_QS_STORED | CF_DETACHED)
+            streams.put_itf8(CID["RL"], len(seq))
+            streams.put_itf8(CID["RI"], refid)
+            ap = pos0 + 1
+            if ap_delta:
+                streams.put_itf8(CID["AP"], ap - prev_ap)
+                prev_ap = ap
+            else:
+                streams.put_itf8(CID["AP"], ap)
+            streams.put_itf8(CID["RG"], -1)
+            streams.put_bytes(CID["RN"], name + b"\x00")
+            streams.put_itf8(CID["MF"], 0)
+            streams.put_itf8(CID["NS"], -1)
+            streams.put_itf8(CID["NP"], 0)
+            streams.put_itf8(CID["TS"], 0)
+            streams.put_itf8(CID["TL"], 0)
+            # one verbatim-bases feature covering the whole read
+            streams.put_itf8(CID["FN"], 1)
+            streams.put_bytes(CID["FC"], b"b")
+            streams.put_itf8(CID["FP"], 1)
+            streams.put_itf8(CID["BB_LEN"], len(seq))
+            streams.put_bytes(CID["BB_VAL"], seq)
+            streams.put_itf8(CID["MQ"], 37)
+            streams.put_bytes(CID["QS"], b"#" * len(seq))
+        from disq_tpu.cram.codec import _enc_external
+
+        comp = CompressionHeader(
+            rn_preserved=True, ap_delta=ap_delta, ref_required=False,
+            tag_lines=[[]],
+        )
+        comp.enc_overrides["RI"] = _enc_external(CID["RI"])
+        ch = Block(COMPRESSION_HEADER, 0, comp.to_bytes(), RAW)
+        ext = [Block(EXTERNAL, cid, bytes(streams.data[cid]), RAW)
+               for cid in sorted(streams.data)]
+        sh = SliceHeader(
+            ref_seq_id=-2, ref_start=0, ref_span=0, n_records=len(recs),
+            record_counter=0, n_blocks=1 + len(ext),
+            content_ids=[b.content_id for b in ext],
+        )
+        return (
+            ch.to_bytes()
+            + Block(MAPPED_SLICE, 0, sh.to_bytes(), RAW).to_bytes()
+            + Block(CORE, 0, b"", RAW).to_bytes()
+            + b"".join(b.to_bytes() for b in ext)
+        )
+
+    @pytest.mark.parametrize("ap_delta", [False, True])
+    def test_multiref_slice_decodes(self, ap_delta):
+        from disq_tpu.cram.codec import decode_container_records
+
+        recs = [
+            (2, 100, b"r1", b"ACGT"),
+            (0, 7, b"r2", b"GGGA"),
+            (5, 250, b"r3", b"TTTTT"),
+            (0, 9, b"r4", b"CA"),
+        ]
+        batch = decode_container_records(self._build_slice(recs, ap_delta))
+        assert batch.count == 4
+        np.testing.assert_array_equal(batch.refid, [2, 0, 5, 0])
+        np.testing.assert_array_equal(batch.pos, [100, 7, 250, 9])
+        from disq_tpu.bam.columnar import SEQ_NT16
+
+        got0 = "".join(SEQ_NT16[v] for v in
+                       batch.seqs[batch.seq_offsets[0]:batch.seq_offsets[1]])
+        assert got0 == "ACGT"
+
+    def test_multiref_reference_tail_uses_record_refid(self):
+        # FN=0 mapped record: the whole read is a reference-matching
+        # tail, fetched with the PER-RECORD refid, not the slice's -2
+        from disq_tpu.cram.codec import decode_container_records
+        from disq_tpu.bam.columnar import SEQ_NT16
+
+        recs = [(3, 10, b"t1", b"")]  # seq comes from the reference
+
+        # build by hand with RL=4 but zero features
+        from disq_tpu.cram.codec import (
+            CF_DETACHED, CF_QS_STORED, CID, CompressionHeader, _Streams,
+        )
+        from disq_tpu.cram.structure import (
+            Block, COMPRESSION_HEADER, CORE, EXTERNAL, MAPPED_SLICE, RAW,
+            SliceHeader,
+        )
+
+        streams = _Streams()
+        streams.put_itf8(CID["BF"], 0)
+        streams.put_itf8(CID["CF"], CF_QS_STORED | CF_DETACHED)
+        streams.put_itf8(CID["RL"], 4)
+        streams.put_itf8(CID["RI"], 3)
+        streams.put_itf8(CID["AP"], 11)
+        streams.put_itf8(CID["RG"], -1)
+        streams.put_bytes(CID["RN"], b"t1\x00")
+        streams.put_itf8(CID["MF"], 0)
+        streams.put_itf8(CID["NS"], -1)
+        streams.put_itf8(CID["NP"], 0)
+        streams.put_itf8(CID["TS"], 0)
+        streams.put_itf8(CID["TL"], 0)
+        streams.put_itf8(CID["FN"], 0)
+        streams.put_itf8(CID["MQ"], 11)
+        streams.put_bytes(CID["QS"], b"####")
+        from disq_tpu.cram.codec import _enc_external
+
+        comp = CompressionHeader(rn_preserved=True, ap_delta=False,
+                                 ref_required=True, tag_lines=[[]])
+        comp.enc_overrides["RI"] = _enc_external(CID["RI"])
+        ch = Block(COMPRESSION_HEADER, 0, comp.to_bytes(), RAW)
+        ext = [Block(EXTERNAL, cid, bytes(streams.data[cid]), RAW)
+               for cid in sorted(streams.data)]
+        sh = SliceHeader(ref_seq_id=-2, ref_start=0, ref_span=0,
+                         n_records=1, record_counter=0,
+                         n_blocks=1 + len(ext),
+                         content_ids=[b.content_id for b in ext])
+        blob = (ch.to_bytes()
+                + Block(MAPPED_SLICE, 0, sh.to_bytes(), RAW).to_bytes()
+                + Block(CORE, 0, b"", RAW).to_bytes()
+                + b"".join(b.to_bytes() for b in ext))
+
+        fetched = []
+
+        def ref_fetch(refid, start0, length):
+            fetched.append((refid, start0, length))
+            return b"GATC"[:length]
+
+        batch = decode_container_records(blob, ref_fetch)
+        assert fetched == [(3, 10, 4)]
+        got = "".join(SEQ_NT16[v] for v in batch.seqs[:4])
+        assert got == "GATC"
+
+    def test_written_headers_do_not_declare_ri(self):
+        # our writer is single-ref: a dangling RI declaration (no
+        # backing block) would break strict foreign readers
+        from disq_tpu.cram.codec import CompressionHeader
+
+        hdr = CompressionHeader(tag_lines=[[]])
+        parsed = CompressionHeader.parse(hdr.to_bytes())
+        assert "RI" not in parsed.series_enc
+        assert "BF" in parsed.series_enc
+
+    def test_multiref_without_ri_series_rejected(self):
+        from disq_tpu.cram.codec import decode_container_records
+
+        blob = self._build_slice([(1, 5, b"x", b"AC")], False)
+        # strip the RI declaration by re-parsing and forging a header
+        # without it is intricate; instead assert the error message path
+        # via a header whose parse drops RI
+        import disq_tpu.cram.codec as codec
+
+        orig = codec.CompressionHeader.parse
+
+        def parse_no_ri(data):
+            out = orig(data)
+            out.series_enc.pop("RI", None)
+            return out
+
+        codec.CompressionHeader.parse = parse_no_ri
+        try:
+            with pytest.raises(ValueError, match="RI series"):
+                decode_container_records(blob)
+        finally:
+            codec.CompressionHeader.parse = orig
