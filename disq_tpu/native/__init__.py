@@ -54,6 +54,17 @@ def _build() -> None:
             os.remove(tmp)
 
 
+def _bind_check(lib: ctypes.CDLL) -> None:
+    """Touch every exported symbol so a stale .so surfaces here (and
+    triggers a rebuild) instead of AttributeError-ing on first use."""
+    for name in (
+        "disq_rans_encode0", "disq_rans_encode1", "disq_rans_decode",
+        "disq_bam_fixed_columns", "disq_bam_fill_ragged",
+        "disq_bam_encode",
+    ):
+        getattr(lib, name)
+
+
 def _load() -> ctypes.CDLL:
     global _lib, _load_error
     if _lib is not None:
@@ -74,6 +85,23 @@ def _load() -> ctypes.CDLL:
             ):
                 _build()
             lib = ctypes.CDLL(_SO)
+            _bind_check(lib)
+        except AttributeError as e:
+            # stale prebuilt .so missing a newer symbol: rebuild when the
+            # source is present, else fail as ImportError so every
+            # caller's Python fallback still engages
+            try:
+                if os.path.exists(_SRC):
+                    _build()
+                    lib = ctypes.CDLL(_SO)
+                    _bind_check(lib)
+                else:
+                    raise
+            except (OSError, subprocess.CalledProcessError,
+                    AttributeError) as e2:
+                _load_error = e2
+                raise ImportError(
+                    f"native library out of date: {e2}") from e
         except (OSError, subprocess.CalledProcessError) as e:
             _load_error = e
             raise ImportError(f"cannot load native library: {e}") from e
@@ -113,6 +141,8 @@ def _load() -> ctypes.CDLL:
         ]
         lib.disq_rans_encode0.restype = ctypes.c_int64
         lib.disq_rans_encode0.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.disq_rans_encode1.restype = ctypes.c_int64
+        lib.disq_rans_encode1.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
         lib.disq_rans_decode.restype = ctypes.c_int64
         lib.disq_rans_decode.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
         lib.disq_bam_encode.restype = ctypes.c_int64
@@ -327,6 +357,22 @@ def rans_encode0_native(raw) -> bytes:
     )
     if got < 0:
         raise ValueError("rANS encode buffer too small")
+    return out[:got].tobytes()
+
+
+def rans_encode1_native(raw) -> bytes:
+    """rANS 4x8 order-1 encode (htslib wire format); byte-identical to
+    the Python codec's rans_encode_order1."""
+    lib = _load()
+    arr = _as_u8(raw)
+    n = len(arr)
+    cap = 9 + 256 * 775 + 16 + (n * 3) // 2 + 64
+    out = np.empty(cap, dtype=np.uint8)
+    got = lib.disq_rans_encode1(
+        _ptr(arr, ctypes.c_uint8), n, _ptr(out, ctypes.c_uint8), cap
+    )
+    if got < 0:
+        raise ValueError("rANS o1 encode buffer too small")
     return out[:got].tobytes()
 
 

@@ -16,9 +16,11 @@ import os
 import numpy as np
 
 
-def env_flag(name: str) -> bool:
-    """Shared boolean env-var semantics: unset/""/0/false/off ⇒ False."""
-    return os.environ.get(name, "0").lower() not in ("", "0", "false", "off")
+def env_flag(name: str, default: str = "0") -> bool:
+    """Shared boolean env-var semantics: unset⇒``default``; ""/0/false/
+    off ⇒ False."""
+    return os.environ.get(name, default).lower() not in (
+        "", "0", "false", "off")
 
 
 def debug_enabled() -> bool:

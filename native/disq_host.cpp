@@ -581,6 +581,106 @@ int64_t disq_rans_encode0(const uint8_t* raw, int64_t n, uint8_t* out,
   return 9 + p;
 }
 
+// Order-1 encode: 4 interleaved states over contiguous quarters,
+// context = previous byte (0 at each quarter start); context tables
+// serialized with RLE-over-contexts. Byte-identical to
+// disq_tpu/cram/rans.py rans_encode_order1 (the htslib wire format the
+// decoder below already reads).
+int64_t disq_rans_encode1(const uint8_t* raw, int64_t n, uint8_t* out,
+                          int64_t out_cap) {
+  if (n == 0) {
+    if (out_cap < 9) return -1;
+    out[0] = 1;
+    std::memset(out + 1, 0, 8);
+    return 9;
+  }
+  int64_t q = n / 4;
+  int64_t starts[4] = {0, q, 2 * q, 3 * q};
+  int64_t ends[4] = {q, 2 * q, 3 * q, n};
+  std::vector<int64_t> counts((size_t)256 * 256, 0);
+  for (int j = 0; j < 4; j++) {
+    uint8_t prev = 0;
+    for (int64_t p2 = starts[j]; p2 < ends[j]; p2++) {
+      counts[(size_t)prev * 256 + raw[p2]]++;
+      prev = raw[p2];
+    }
+  }
+  std::vector<int64_t> freqs((size_t)256 * 256, 0);
+  std::vector<int64_t> cum((size_t)256 * 257, 0);
+  bool present[256] = {false};
+  for (int c = 0; c < 256; c++) {
+    int64_t tot = 0;
+    for (int s = 0; s < 256; s++) tot += counts[(size_t)c * 256 + s];
+    if (!tot) continue;
+    present[c] = true;
+    rans_normalize(&counts[(size_t)c * 256], &freqs[(size_t)c * 256]);
+    for (int s = 0; s < 256; s++)
+      cum[(size_t)c * 257 + s + 1] =
+          cum[(size_t)c * 257 + s] + freqs[(size_t)c * 256 + s];
+  }
+  // worst-case table area: 256 contexts x (ids + 771-byte table)
+  if (out_cap < 9 + 256 * 775 + 16 + (n * 3) / 2 + 64) return -1;
+  uint8_t* body = out + 9;
+  int64_t p = 0;
+  int plist[256];
+  int np_ = 0;
+  for (int c = 0; c < 256; c++)
+    if (present[c]) plist[np_++] = c;
+  int i = 0;
+  while (i < np_) {
+    int run = 1;
+    while (i + run < np_ && plist[i + run] == plist[i] + run) run++;
+    body[p++] = (uint8_t)plist[i];
+    p += rans_write_table0(&freqs[(size_t)plist[i] * 256], body + p);
+    if (run > 1) {
+      // parser: nxt == last+1 -> read an rle count, then auto-advance
+      body[p++] = (uint8_t)(plist[i] + 1);
+      body[p++] = (uint8_t)(run - 2);
+      for (int k = 1; k < run; k++)
+        p += rans_write_table0(&freqs[(size_t)(plist[i] + k) * 256],
+                               body + p);
+    }
+    i += run;
+  }
+  body[p++] = 0;
+  // encode: exact reverse of the decoder's round-robin pop schedule
+  int64_t lens[4];
+  for (int j = 0; j < 4; j++) lens[j] = ends[j] - starts[j];
+  int64_t kmax = 0;
+  for (int j = 0; j < 4; j++)
+    if (lens[j] > kmax) kmax = lens[j];
+  std::vector<uint8_t> rev;
+  rev.reserve((size_t)n / 2);
+  uint32_t states[4] = {kRansLow, kRansLow, kRansLow, kRansLow};
+  for (int64_t k = kmax - 1; k >= 0; k--) {
+    for (int j = 3; j >= 0; j--) {
+      if (k >= lens[j]) continue;
+      int64_t pos = starts[j] + k;
+      int s = raw[pos];
+      int c = (k == 0) ? 0 : raw[pos - 1];
+      uint32_t x = states[j];
+      uint32_t f = (uint32_t)freqs[(size_t)c * 256 + s];
+      uint32_t x_max = ((kRansLow >> kTfShift) << 8) * f;
+      while (x >= x_max) {
+        rev.push_back((uint8_t)(x & 0xFF));
+        x >>= 8;
+      }
+      states[j] =
+          ((x / f) << kTfShift) + (x % f) + (uint32_t)cum[(size_t)c * 257 + s];
+    }
+  }
+  for (int j = 0; j < 4; j++) {
+    std::memcpy(body + p, &states[j], 4);
+    p += 4;
+  }
+  for (int64_t k = (int64_t)rev.size() - 1; k >= 0; k--) body[p++] = rev[k];
+  out[0] = 1;
+  uint32_t comp = (uint32_t)p, rs = (uint32_t)n;
+  std::memcpy(out + 1, &comp, 4);
+  std::memcpy(out + 5, &rs, 4);
+  return 9 + p;
+}
+
 // Decode (order 0 or 1). data = full stream incl. 9-byte header; out
 // must hold raw_size bytes (as announced in the header — the caller
 // reads it first). Returns 0, or a negative error code.
