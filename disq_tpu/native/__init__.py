@@ -54,15 +54,55 @@ def _build() -> None:
             os.remove(tmp)
 
 
-def _bind_check(lib: ctypes.CDLL) -> None:
-    """Touch every exported symbol so a stale .so surfaces here (and
-    triggers a rebuild) instead of AttributeError-ing on first use."""
-    for name in (
-        "disq_rans_encode0", "disq_rans_encode1", "disq_rans_decode",
-        "disq_bam_fixed_columns", "disq_bam_fill_ragged",
-        "disq_bam_encode",
-    ):
-        getattr(lib, name)
+def _bind(lib: ctypes.CDLL) -> None:
+    """Resolve and prototype every exported symbol. A stale prebuilt
+    .so missing any newer symbol raises AttributeError HERE (inside the
+    guarded load path), never at first call."""
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.disq_scan_bam_offsets.restype = ctypes.c_int64
+    lib.disq_scan_bam_offsets.argtypes = [u8p, ctypes.c_int64, i64p, ctypes.c_int64]
+    lib.disq_count_bam_records.restype = ctypes.c_int64
+    lib.disq_count_bam_records.argtypes = [u8p, ctypes.c_int64]
+    lib.disq_bgzf_walk.restype = ctypes.c_int64
+    lib.disq_bgzf_walk.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, i64p, i32p, i32p,
+        ctypes.c_int64,
+    ]
+    lib.disq_bgzf_inflate_many.restype = ctypes.c_int64
+    lib.disq_bgzf_inflate_many.argtypes = [
+        u8p, i64p, i32p, i32p, i32p, ctypes.c_int64, u8p, i64p,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.disq_bgzf_deflate_many.restype = ctypes.c_int64
+    lib.disq_bgzf_deflate_many.argtypes = [
+        u8p, i64p, ctypes.c_int64, u8p, ctypes.c_int64, i32p,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.disq_bam_fixed_columns.restype = ctypes.c_int64
+    lib.disq_bam_fixed_columns.argtypes = [
+        u8p, ctypes.c_int64, i64p, ctypes.c_int64, i32p, i32p, u8p,
+        u16p, u16p, i32p, i32p, i32p, i64p, i64p, i64p, i64p,
+    ]
+    lib.disq_bam_fill_ragged.restype = ctypes.c_int64
+    lib.disq_bam_fill_ragged.argtypes = [
+        u8p, i64p, ctypes.c_int64, i64p, u8p, i64p, u32p, i64p, u8p,
+        u8p, i64p, u8p,
+    ]
+    lib.disq_rans_encode0.restype = ctypes.c_int64
+    lib.disq_rans_encode0.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.disq_rans_encode1.restype = ctypes.c_int64
+    lib.disq_rans_encode1.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.disq_rans_decode.restype = ctypes.c_int64
+    lib.disq_rans_decode.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+    lib.disq_bam_encode.restype = ctypes.c_int64
+    lib.disq_bam_encode.argtypes = [
+        u8p, i64p, ctypes.c_int64, i32p, i32p, u8p, u16p, u16p, i32p,
+        i32p, i32p, i64p, u8p, i64p, u32p, i64p, u8p, u8p, i64p, u8p,
+    ]
 
 
 def _load() -> ctypes.CDLL:
@@ -79,77 +119,27 @@ def _load() -> ctypes.CDLL:
         if _load_error is not None:
             raise ImportError(f"native library unavailable: {_load_error}")
         try:
-            if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-            ):
-                _build()
-            lib = ctypes.CDLL(_SO)
-            _bind_check(lib)
-        except AttributeError as e:
-            # stale prebuilt .so missing a newer symbol: rebuild when the
-            # source is present, else fail as ImportError so every
-            # caller's Python fallback still engages
-            try:
-                if os.path.exists(_SRC):
-                    _build()
+            for attempt in (0, 1):
+                try:
+                    if attempt or not os.path.exists(_SO) or (
+                        os.path.exists(_SRC)
+                        and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+                    ):
+                        _build()
                     lib = ctypes.CDLL(_SO)
-                    _bind_check(lib)
-                else:
-                    raise
-            except (OSError, subprocess.CalledProcessError,
-                    AttributeError) as e2:
-                _load_error = e2
-                raise ImportError(
-                    f"native library out of date: {e2}") from e
-        except (OSError, subprocess.CalledProcessError) as e:
+                    _bind(lib)
+                    break
+                except AttributeError:
+                    # stale prebuilt .so missing a newer symbol: one
+                    # rebuild attempt when the source is present, else a
+                    # clean ImportError so every caller's Python
+                    # fallback engages
+                    if attempt or not os.path.exists(_SRC):
+                        raise
+        except (OSError, subprocess.CalledProcessError,
+                AttributeError) as e:
             _load_error = e
             raise ImportError(f"cannot load native library: {e}") from e
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.disq_scan_bam_offsets.restype = ctypes.c_int64
-        lib.disq_scan_bam_offsets.argtypes = [u8p, ctypes.c_int64, i64p, ctypes.c_int64]
-        lib.disq_count_bam_records.restype = ctypes.c_int64
-        lib.disq_count_bam_records.argtypes = [u8p, ctypes.c_int64]
-        lib.disq_bgzf_walk.restype = ctypes.c_int64
-        lib.disq_bgzf_walk.argtypes = [
-            u8p, ctypes.c_int64, ctypes.c_int64, i64p, i32p, i32p,
-            ctypes.c_int64,
-        ]
-        lib.disq_bgzf_inflate_many.restype = ctypes.c_int64
-        lib.disq_bgzf_inflate_many.argtypes = [
-            u8p, i64p, i32p, i32p, i32p, ctypes.c_int64, u8p, i64p,
-            ctypes.c_int32, ctypes.c_int32,
-        ]
-        lib.disq_bgzf_deflate_many.restype = ctypes.c_int64
-        lib.disq_bgzf_deflate_many.argtypes = [
-            u8p, i64p, ctypes.c_int64, u8p, ctypes.c_int64, i32p,
-            ctypes.c_int32, ctypes.c_int32,
-        ]
-        u16p = ctypes.POINTER(ctypes.c_uint16)
-        u32p = ctypes.POINTER(ctypes.c_uint32)
-        lib.disq_bam_fixed_columns.restype = ctypes.c_int64
-        lib.disq_bam_fixed_columns.argtypes = [
-            u8p, ctypes.c_int64, i64p, ctypes.c_int64, i32p, i32p, u8p,
-            u16p, u16p, i32p, i32p, i32p, i64p, i64p, i64p, i64p,
-        ]
-        lib.disq_bam_fill_ragged.restype = ctypes.c_int64
-        lib.disq_bam_fill_ragged.argtypes = [
-            u8p, i64p, ctypes.c_int64, i64p, u8p, i64p, u32p, i64p, u8p,
-            u8p, i64p, u8p,
-        ]
-        lib.disq_rans_encode0.restype = ctypes.c_int64
-        lib.disq_rans_encode0.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
-        lib.disq_rans_encode1.restype = ctypes.c_int64
-        lib.disq_rans_encode1.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
-        lib.disq_rans_decode.restype = ctypes.c_int64
-        lib.disq_rans_decode.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
-        lib.disq_bam_encode.restype = ctypes.c_int64
-        lib.disq_bam_encode.argtypes = [
-            u8p, i64p, ctypes.c_int64, i32p, i32p, u8p, u16p, u16p, i32p,
-            i32p, i32p, i64p, u8p, i64p, u32p, i64p, u8p, u8p, i64p, u8p,
-        ]
         _lib = lib
         return lib
 
