@@ -145,6 +145,23 @@ class ReadsDataset:
         header = self.header.with_sort_order("coordinate")
         return ReadsDataset(header=header, reads=coordinate_sort_batch(self.reads))
 
+    def device_columns(self, sharding=None) -> dict:
+        """The fixed record columns as device-resident jax Arrays (one
+        upload each; optionally placed with a ``NamedSharding``) — the
+        HBM-resident shard-buffer form the device kernels consume
+        (``runtime/device_pipeline``, ``ops/flagstat``, ``ops/depth``).
+        Ragged byte columns stay host-side (their device movement is
+        the sort exchange's padded-matrix path)."""
+        import jax
+
+        cols = {}
+        for name in ("refid", "pos", "mapq", "flag", "bin",
+                     "next_refid", "next_pos", "tlen"):
+            arr = np.ascontiguousarray(getattr(self.reads, name))
+            cols[name] = (jax.device_put(arr, sharding)
+                          if sharding is not None else jax.device_put(arr))
+        return cols
+
     # -- device analytics ---------------------------------------------------
 
     def flagstat(self, mesh=None, axis: str = "shards") -> dict:

@@ -79,3 +79,31 @@ class TestDevicePipeline:
         keys, order, stats = run_device_pipeline(
             blob, np.zeros(1, np.int64), interpret=True)
         assert len(keys) == 0 and stats["total"] == 0
+
+
+class TestDeviceColumns:
+    def test_device_backed_dataset_columns(self, tmp_path):
+        from disq_tpu.api import ReadsStorage
+
+        raw = make_bam_bytes(DEFAULT_REFS, synth_records(300, seed=6))
+        p = tmp_path / "a.bam"
+        p.write_bytes(raw)
+        ds = ReadsStorage.make_default().read(str(p))
+        cols = ds.device_columns()
+        assert set(cols) >= {"refid", "pos", "flag", "mapq"}
+        for v in cols.values():
+            assert isinstance(v, jax.Array)
+        np.testing.assert_array_equal(np.asarray(cols["pos"]), ds.reads.pos)
+
+    def test_device_columns_sharded(self, tmp_path):
+        from disq_tpu.api import ReadsStorage
+        from disq_tpu.sort.sharded import make_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        raw = make_bam_bytes(DEFAULT_REFS, synth_records(256, seed=7))
+        p = tmp_path / "b.bam"
+        p.write_bytes(raw)
+        ds = ReadsStorage.make_default().read(str(p))
+        mesh = make_mesh(8)
+        cols = ds.device_columns(NamedSharding(mesh, P("shards")))
+        assert len(cols["flag"].sharding.device_set) == 8
