@@ -31,9 +31,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_device_kernels_on_chip(tmp_path):
     out = tmp_path / "TPU_KERNELS.json"
+    # Drop the conftest's forced-CPU overrides but keep PYTHONPATH:
+    # the TPU plugin registers through the image's sitecustomize dir on
+    # PYTHONPATH, and `python -m` with cwd=REPO resolves disq_tpu by
+    # itself. JAX_PLATFORMS is unset (auto-select) rather than copied,
+    # because the conftest already overwrote the original value.
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["PYTHONPATH"] = REPO
     proc = subprocess.run(
         [sys.executable, "-m", "disq_tpu.ops.tpu_ci", str(out)],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
