@@ -1,0 +1,80 @@
+"""Multi-host execution scaffold — the DCN/ICI story (SURVEY.md §5).
+
+disq scales by adding Spark executors over the network; the TPU-native
+equivalent is multi-process jax: one process per host, every process
+sees the global device set, and collectives route over ICI within a
+slice and DCN across slices. This module wraps the two pieces the rest
+of the framework needs:
+
+- ``initialize(...)`` — ``jax.distributed.initialize`` with the
+  coordinator bootstrap (the Spark-driver analogue; no-op when
+  single-process).
+- ``global_mesh(...)`` — a mesh over ALL processes' devices with the
+  host boundary as the leading ``dcn`` axis and per-host devices on the
+  ``shards`` axis, so the sort exchange's ``all_to_all`` rides ICI and
+  only inter-host reductions cross DCN (the scaling-book layering).
+
+No multi-host hardware exists in this environment; the axis-planning
+arithmetic is pure and unit-tested, the single-process path degrades to
+the ordinary local mesh, and the 8-virtual-device suite exercises the
+resulting meshes end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def plan_axes(n_devices_total: int, n_processes: int) -> Tuple[int, int]:
+    """(dcn, shards) axis sizes: hosts on the outer (DCN) axis, the
+    per-host device count on the inner (ICI) axis."""
+    if n_processes <= 0:
+        raise ValueError("n_processes must be positive")
+    if n_devices_total % n_processes:
+        raise ValueError(
+            f"{n_devices_total} devices do not split over "
+            f"{n_processes} processes")
+    return n_processes, n_devices_total // n_processes
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: int = 1,
+               process_id: int = 0) -> None:
+    """Bootstrap multi-process jax (no-op for a single process).
+
+    ``coordinator_address`` is ``host:port`` of process 0 — the same
+    rendezvous role the Spark driver plays for executors.
+    """
+    if num_processes <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(dcn_axis: str = "dcn", ici_axis: str = "shards"):
+    """Mesh over every device of every process: (n_hosts, per_host),
+    DCN-boundary outer, ICI inner. Single-process: (1, n_local)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n_proc = jax.process_count()
+    dcn, per_host = plan_axes(len(devs), n_proc)
+    arr = np.empty((dcn, per_host), dtype=object)
+    for d in devs:
+        # jax orders devices by (process_index, local ordinal); place
+        # explicitly so the DCN axis is exactly the host boundary
+        arr[d.process_index, _local_ordinal(d, devs)] = d
+    return Mesh(arr, (dcn_axis, ici_axis))
+
+
+def _local_ordinal(dev, devs) -> int:
+    same = [d for d in devs if d.process_index == dev.process_index]
+    return sorted(same, key=lambda d: d.id).index(dev)

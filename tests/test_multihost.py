@@ -1,0 +1,64 @@
+"""Multi-host scaffold (SURVEY.md §5 distributed comm backend).
+
+No multi-host hardware exists here: the axis planner is pure and
+tested directly; the global mesh degrades to the local device set in
+one process, and a psum over both mesh axes runs on the virtual
+8-device mesh to prove the (dcn, shards) layering compiles and
+executes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from disq_tpu.runtime.multihost import global_mesh, initialize, plan_axes
+
+
+class TestPlanAxes:
+    def test_splits(self):
+        assert plan_axes(32, 4) == (4, 8)
+        assert plan_axes(8, 1) == (1, 8)
+        assert plan_axes(8, 8) == (8, 1)
+
+    def test_rejects_uneven(self):
+        with pytest.raises(ValueError):
+            plan_axes(10, 4)
+        with pytest.raises(ValueError):
+            plan_axes(8, 0)
+
+
+class TestGlobalMesh:
+    def test_single_process_shape(self):
+        mesh = global_mesh()
+        assert mesh.shape["dcn"] == 1
+        assert mesh.shape["shards"] == len(jax.devices())
+        assert set(np.asarray(mesh.devices).ravel()) == set(jax.devices())
+
+    def test_initialize_single_process_noop(self):
+        initialize(num_processes=1)  # must not raise or require network
+
+    def test_collective_over_both_axes(self):
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+
+        mesh = global_mesh()
+        n = mesh.shape["dcn"] * mesh.shape["shards"]
+
+        def body(x):
+            # inner (ICI) reduction then outer (DCN) reduction — the
+            # layering the sort/flagstat collectives use
+            s = jax.lax.psum(x, "shards")
+            return jax.lax.psum(s, "dcn")
+
+        x = jnp.ones((n, 4))
+        out = shard_map(
+            body, mesh=mesh, in_specs=P(("dcn", "shards"), None),
+            out_specs=P(("dcn", "shards"), None))(x)
+        np.testing.assert_array_equal(np.asarray(out), np.full((n, 4), n))
