@@ -248,11 +248,18 @@ def rans_decode(data: bytes) -> bytes:
     if order == 0:
         from disq_tpu.runtime.debug import env_flag
 
-        if env_flag("DISQ_TPU_DEVICE_RANS"):
-            # Pallas kernel path (order-0): disq_tpu.ops.rans.
+        import os
+
+        if os.environ.get("DISQ_TPU_DEVICE_RANS", "").lower() == "legacy":
+            # round-1 scalar kernel (one stream per grid program)
             from disq_tpu.ops.rans import rans0_decode_device
 
             return rans0_decode_device([data])[0]
+        if env_flag("DISQ_TPU_DEVICE_RANS"):
+            # 128-lane SIMD kernel path: disq_tpu.ops.rans_simd.
+            from disq_tpu.ops.rans_simd import rans0_decode_simd
+
+            return rans0_decode_simd([data])[0]
     if order in (0, 1):
         try:
             from disq_tpu.native import rans_decode_native

@@ -170,36 +170,20 @@ def rans0_decode_device(streams: List[bytes], interpret=None) -> List[bytes]:
     """Decode a batch of order-0 rANS 4x8 streams (full streams incl.
     the 9-byte header) on device. Tables parse host-side (O(alphabet));
     the per-byte loop runs in the kernel."""
-    import struct
-
-    from disq_tpu.cram.rans import _read_freq_table0
+    # shared header/table/state parse + validation (single source of
+    # truth with the SIMD kernel — both kernels accept the same streams)
+    from disq_tpu.ops.rans_simd import _parse_stream
 
     b = len(streams)
     if b == 0:
         return []
     metas = []
     for k, s in enumerate(streams):
-        order, comp_size, raw_size = struct.unpack_from("<BII", s, 0)
-        if order != 0:
-            raise ValueError(f"stream {k}: kernel handles order-0 only")
-        body = bytes(s[9: 9 + comp_size])
-        if raw_size == 0:
+        p = _parse_stream(k, s)
+        if p is None:
             metas.append(None)
             continue
-        freqs, off = _read_freq_table0(body, 0)
-        if int(freqs.sum()) != TOTFREQ:
-            raise ValueError(f"stream {k}: frequency table sum != 4096")
-        cum = np.zeros(257, dtype=np.int64)
-        np.cumsum(freqs, out=cum[1:])
-        states = np.frombuffer(body, dtype="<u4", count=4, offset=off)
-        # The kernel carries states as int32; a valid encoder never
-        # produces a state >= 2^31 (encode caps x below kRansLow<<8 ≈
-        # 2^31), so reject rather than wrap negative and decode garbage.
-        if int(states.max(initial=0)) >= 1 << 31:
-            raise ValueError(
-                f"stream {k}: corrupt rANS state word >= 2^31"
-            )
-        renorm = body[off + 16:]
+        raw_size, renorm, states, freqs, cum = p
         lookup = np.repeat(np.arange(256, dtype=np.int32), freqs)
         metas.append((raw_size, renorm, states, freqs, cum[:256], lookup))
 

@@ -71,7 +71,9 @@ class TestRans0Kernel:
             rans0_decode_device([bytes(enc[: 9 + comp_size - 40])], interpret=True)
 
     def test_env_flag_routes_decode(self, monkeypatch):
-        monkeypatch.setenv("DISQ_TPU_DEVICE_RANS", "1")
+        # "legacy" selects THIS kernel ("1" now routes to the SIMD one,
+        # covered by test_rans_simd_kernel.py)
+        monkeypatch.setenv("DISQ_TPU_DEVICE_RANS", "legacy")
         raw = _markov(4000, 4)
         assert rans_decode(rans_encode_order0(raw)) == raw
 
