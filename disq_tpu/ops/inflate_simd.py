@@ -12,13 +12,20 @@ lane, every piece of decoder state a ``(1, 128)`` vector.
 Per superstep (one ``lax.while_loop`` iteration), every lane advances
 its own predicated state machine — header / stored / dynamic-table
 build / symbol decode / distance / LZ77 copy — by pure vector selects;
-there is no ``lax.cond`` on the hot path (only rare events like table
-finalization are gated with ``pl.when``). Each lane emits at most one
-output byte per superstep. All data-dependent indexing uses the one
-vector-gather primitive PROBES.md proved both correct and fast on the
-VPU: the one-hot row gather ``sum(where(row_iota == idx, data, 0))``
-(54 ns over (512,128); ``take_along_axis``/1-D gathers miscompile or
-crash Mosaic).
+rare events (table finalization, dyn-block entry, table-phase stores)
+are gated with ``pl.when``, and the refill/far-history sweeps behind
+``lax.cond`` whole-warp gates. A lane emits 1 output byte per literal
+superstep, up to 4 per stored/short-copy superstep, and up to 8 (two
+output words) in the aligned steady state of a long match (d >= 8).
+All data-dependent indexing uses the one vector-gather primitive
+PROBES.md proved both correct and fast on the VPU: the one-hot row
+gather ``sum(where(row_iota == idx, data, 0))`` (54 ns over (512,128);
+``take_along_axis``/1-D gathers miscompile or crash Mosaic). Big-buffer
+sweeps (comp refill, output RMW, far-history reads) are additionally
+*windowed*: lanes advance in rough lockstep, so each slab's sweep is
+skipped when the live row window [min, max] misses it. Mosaic pitfall
+learned here: bool (1,128) vectors do not survive ``lax.cond`` return
+lowering — carry them as i32 across the branch.
 
 Huffman decoding is bit-serial canonical (puff-style count/first/offset
 walk) rather than root-table driven: the per-length arrays are (16,128)
@@ -125,6 +132,32 @@ def _gather_ref(ref, rows, slab: int = _SLAB):
         sl = min(slab, r - s)
         g = _gather(ref[s:s + sl, :], rows - s)
         acc = g if acc is None else acc | g
+    return acc
+
+
+def _gather_ref_win(ref, rows, slab: int = _SLAB):
+    """Windowed one-hot row gather: like ``_gather_ref`` but each
+    slab's sweep is skipped (``lax.cond``) when no lane's row lands in
+    it. Lanes decode at similar rates, so the live row window [min,
+    max] usually spans one or two slabs and the other sweeps vanish —
+    the big-buffer gathers drop from O(R) to O(window). Row -1 (the
+    folded-miss convention) never anchors the window."""
+    r = ref.shape[0]
+    if r <= slab:
+        return _gather(ref[...], rows)
+    rmin = jnp.min(jnp.where(rows < 0, jnp.int32(r), rows))
+    rmax = jnp.max(rows)
+    acc = jnp.zeros((1, LANES), ref.dtype)
+    for s in range(0, r, slab):
+        sl = min(slab, r - s)
+
+        def hit(s=s, sl=sl):
+            return _gather(ref[s:s + sl, :], rows - s)
+
+        g = lax.cond(
+            (rmax >= s) & (rmin < s + sl), hit,
+            lambda: jnp.zeros((1, LANES), ref.dtype))
+        acc = acc | g
     return acc
 
 
@@ -309,17 +342,27 @@ def _inflate_simd_kernel(
     # pre-phase-B refill restores >= 33, dist code <= 15 leaves >= 18
     # >= 13 extra bits. No unaligned double-gather assembly.
     def refill64(lo, hi, cnt, in_w):
-        w = _gather_ref(comp_ref, jnp.minimum(in_w, cw - 1)).astype(_U32)
-        do = cnt <= 32
-        cu = jnp.minimum(cnt, 31).astype(_U32)
-        lo = jnp.where(do & (cnt < 32), lo | (w << cu), lo)
-        hi_add = jnp.where(
-            cnt == 32, w,
-            jnp.where(cnt > 0, w >> ((_U32(32) - cu) & _U32(31)), zrow_u))
-        hi = jnp.where(do, hi | hi_add, hi)
-        cnt = cnt + jnp.where(do, 32, 0)
-        in_w = in_w + jnp.where(do, 1, 0)
-        return lo, hi, cnt, in_w
+        def do_refill(lo, hi, cnt, in_w):
+            w = _gather_ref_win(
+                comp_ref, jnp.minimum(in_w, cw - 1)).astype(_U32)
+            do = cnt <= 32
+            cu = jnp.minimum(cnt, 31).astype(_U32)
+            lo = jnp.where(do & (cnt < 32), lo | (w << cu), lo)
+            hi_add = jnp.where(
+                cnt == 32, w,
+                jnp.where(cnt > 0, w >> ((_U32(32) - cu) & _U32(31)),
+                          zrow_u))
+            hi = jnp.where(do, hi | hi_add, hi)
+            cnt = cnt + jnp.where(do, 32, 0)
+            in_w = in_w + jnp.where(do, 1, 0)
+            return lo, hi, cnt, in_w
+
+        # whole-warp gate: only sweep the comp columns when some lane
+        # actually has room (cnt <= 32)
+        return lax.cond(
+            jnp.any(cnt <= 32), do_refill,
+            lambda lo, hi, cnt, in_w: (lo, hi, cnt, in_w),
+            lo, hi, cnt, in_w)
 
     def consume64(lo, hi, cnt, n):
         """Drop n (0..32, per-lane) low bits from the pair. n == 32
@@ -373,9 +416,15 @@ def _inflate_simd_kernel(
         fixed = jnp.where(m, (btype == 1).astype(_I32), fixed)
         used = jnp.where(m, h_used, used)
         # zero the code-length buffers for lanes starting a dyn block
+        # (rare event — gate the (320,128)/(19,128) sweeps off the
+        # common superstep)
         mdyn = m & (btype == 2)
-        _masked_rows(lens_ref, jnp.zeros(lens_ref.shape, _I32), mdyn)
-        _masked_rows(cl_lens_ref, jnp.zeros(cl_lens_ref.shape, _I32), mdyn)
+
+        @pl.when(jnp.any(mdyn))
+        def _():
+            _masked_rows(lens_ref, jnp.zeros(lens_ref.shape, _I32), mdyn)
+            _masked_rows(
+                cl_lens_ref, jnp.zeros(cl_lens_ref.shape, _I32), mdyn)
 
         # ---- STORED len/nlen/copy -----------------------------------
         m = state == _SLEN
@@ -440,8 +489,12 @@ def _inflate_simd_kernel(
         m = state == _TBCODELEN
         total = hlit + hdist
         in_rep = m & (rep_cnt > 0)
-        # repeat write
-        _store_row(lens_ref, tb_nread, rep_val, in_rep & (tb_nread < total))
+
+        # repeat write ((320,128) sweep — table-read phases only)
+        @pl.when(jnp.any(in_rep))
+        def _():
+            _store_row(lens_ref, tb_nread, rep_val,
+                       in_rep & (tb_nread < total))
         new_status = jnp.where(in_rep & (tb_nread >= total), 7, new_status)
         new_state = jnp.where(in_rep & (tb_nread >= total), _ERR, new_state)
         tb_nread = jnp.where(in_rep, tb_nread + 1, tb_nread)
@@ -449,6 +502,7 @@ def _inflate_simd_kernel(
         prev_len = jnp.where(in_rep, rep_val, prev_len)
 
         mdec = m & ~in_rep
+
         cidx, cbits, cfound = _decode_canonical(
             bitbuf, 7, cntc_ref[...], firstc_ref[...], offc_ref[...])
         csym = _gather(symcl_ref[...], cidx)
@@ -457,7 +511,10 @@ def _inflate_simd_kernel(
         new_state = jnp.where(bad, _ERR, new_state)
         # literal length 0..15
         ml = mdec & cfound & (csym <= 15)
-        _store_row(lens_ref, tb_nread, csym, ml & (tb_nread < total))
+
+        @pl.when(jnp.any(ml))
+        def _():
+            _store_row(lens_ref, tb_nread, csym, ml & (tb_nread < total))
         new_status = jnp.where(ml & (tb_nread >= total), 7, new_status)
         new_state = jnp.where(ml & (tb_nread >= total), _ERR, new_state)
         prev_len = jnp.where(ml, csym, prev_len)
@@ -496,11 +553,15 @@ def _inflate_simd_kernel(
         # ---- DECODE: one literal/length symbol -----------------------
         m = state == _DECODE
         fixed_b = fixed != 0
+
         didx, dbits, dfound = _decode_canonical(
             bitbuf, 15, cntl_ref[...], firstl_ref[...], offl_ref[...],
             _FCNT_L, _FFIRST_L, _FOFF_L, fixed_b)
         symdata = jnp.where(fixed_b, fsyml_ref[...], symlit_ref[...])
         sym = _gather(symdata, didx)
+        li = jnp.clip(sym - 257, 0, 28)
+        lext = _gather(lext_ref[...], li)
+        lbase = _gather(lbase_ref[...], li)
         bad = m & ~dfound
         new_status = jnp.where(bad, 3, new_status)
         new_state = jnp.where(bad, _ERR, new_state)
@@ -514,12 +575,9 @@ def _inflate_simd_kernel(
         new_state = jnp.where(meob, after_block, new_state)
         # length code
         mlen = mok & (sym > 256)
-        li = jnp.clip(sym - 257, 0, 28)
         bad_len = mlen & (sym - 257 > 28)
         new_status = jnp.where(bad_len, 3, new_status)
         new_state = jnp.where(bad_len, _ERR, new_state)
-        lext = _gather(lext_ref[...], li)
-        lbase = _gather(lbase_ref[...], li)
         lex_v = ((bitbuf >> dbits.astype(_U32)) &
                  _mask_bits(lext)).astype(_I32)
         copy_len = jnp.where(mlen, lbase + lex_v, copy_len)
@@ -536,20 +594,21 @@ def _inflate_simd_kernel(
         # only guarantees 25, so the code is consumed and the buffer
         # refilled BEFORE the extra bits are read.
         m = (state == _DIST) & live
+
         xidx, xbits, xfound = _decode_canonical(
             bitbuf, 15, cntd_ref[...], firstd_ref[...], offd_ref[...],
             _FCNT_D, _FFIRST_D, _FOFF_D, fixed_b)
         symdata_d = jnp.where(fixed_b, fsymd_ref[...], symdist_ref[...])
         dsym = _gather(symdata_d, xidx)
+        dsym_c = jnp.clip(dsym, 0, 29)
+        dext = _gather(dext_ref[...], dsym_c)
+        dbase = _gather(dbase_ref[...], dsym_c)
         bad = m & (~xfound | (dsym > 29))
         new_status = jnp.where(bad, 3, new_status)
         new_state = jnp.where(bad, _ERR, new_state)
         mok = m & ~bad
         lo, hi, cnt = consume64(lo, hi, cnt, jnp.where(m, xbits, zrow))
         bitbuf = lo
-        dsym_c = jnp.clip(dsym, 0, 29)
-        dext = _gather(dext_ref[...], dsym_c)
-        dbase = _gather(dbase_ref[...], dsym_c)
         dex_v = (bitbuf & _mask_bits(dext)).astype(_I32)
         dist = dbase + dex_v
         bad_d = mok & ((dist > outpos) | (dist > 32768))
@@ -559,35 +618,47 @@ def _inflate_simd_kernel(
         new_state = jnp.where(mok & ~bad_d, _COPY, new_state)
         lo, hi, cnt = consume64(lo, hi, cnt, jnp.where(mok, dext, zrow))
 
-        # ---- COPY: up to 4 history bytes per superstep ---------------
+        # ---- COPY: up to 8 history bytes per superstep ---------------
         # Source bytes come from the 4 KiB circular history ring (last
         # 4096 bytes, word rows = w & (RING_W-1)); distances past the
         # ring window read the big out buffer under a gated cond. For
         # d < 4 the 4 fetched bytes start at outpos-d and are replicated
         # modularly (byte j := B[j mod d]), so only written bytes are
-        # ever read.
+        # ever read. When the output is word-aligned and d >= 8 (the
+        # common steady state inside a long match — the first partial
+        # step aligns it), TWO words emit per superstep straight from
+        # the source, halving the superstep count of long copies.
         m = (state == _COPY) & live
         d = copy_dist
-        ck = jnp.minimum(kmax, copy_len)
+        elig8 = m & (off == 0) & (d >= 8)
+        ck = jnp.minimum(jnp.where(elig8, 8, kmax), copy_len)
         base = outpos - d
         bw = base >> 2
         bo = ((base & 3) << 3).astype(_U32)
         rw0 = _gather(ring_ref[...], jnp.where(m, bw & (RING_W - 1), -1))
         rw1 = _gather(ring_ref[...],
                       jnp.where(m, (bw + 1) & (RING_W - 1), -1))
+        rw2 = _gather(ring_ref[...],
+                      jnp.where(elig8, (bw + 2) & (RING_W - 1), -1))
         far = m & (d > RING_SAFE)
 
         def far_fetch():
             r0 = jnp.where(far, jnp.minimum(bw, ow - 1), -1)
             r1 = jnp.where(far, jnp.minimum(bw + 1, ow - 1), -1)
-            return _gather_ref(out_ref, r0), _gather_ref(out_ref, r1)
+            r2 = jnp.where(far & elig8, jnp.minimum(bw + 2, ow - 1), -1)
+            return (_gather_ref_win(out_ref, r0),
+                    _gather_ref_win(out_ref, r1),
+                    _gather_ref_win(out_ref, r2))
 
-        fw0, fw1 = lax.cond(
-            jnp.any(far), far_fetch, lambda: (zrow_u, zrow_u))
+        fw0, fw1, fw2 = lax.cond(
+            jnp.any(far), far_fetch, lambda: (zrow_u, zrow_u, zrow_u))
         w0 = jnp.where(far, fw0, rw0)
         w1 = jnp.where(far, fw1, rw1)
+        w2 = jnp.where(far, fw2, rw2)
         asm = jnp.where(
             bo == 0, w0, (w0 >> bo) | (w1 << ((_U32(32) - bo) & _U32(31))))
+        asm2 = jnp.where(
+            bo == 0, w1, (w1 >> bo) | (w2 << ((_U32(32) - bo) & _U32(31))))
         b0 = asm & 0xFF
         b1 = (asm >> 8) & 0xFF
         b2 = (asm >> 16) & 0xFF
@@ -601,33 +672,55 @@ def _inflate_simd_kernel(
                                   jnp.where(d == 3, r3, asm)))
         emit_k = jnp.where(m, ck, emit_k)
         packed = jnp.where(m, cpk, packed)
+        packed_hi = jnp.where(elig8, asm2, zrow_u)
         copy_len = jnp.where(m, copy_len - ck, copy_len)
         new_state = jnp.where(m & (copy_len == 0), _DECODE, new_state)
 
         # ---- emit merge ---------------------------------------------
+        # up to 2 output words per lane: the low word carries bytes at
+        # the current offset as before; the high word exists only for
+        # 8-byte copy emits (off == 0 guaranteed there, so it is whole)
         emit_k = jnp.where(live & (new_state != _ERR), emit_k, zrow)
         over = (emit_k > 0) & (outpos + emit_k > ow * 4)
         new_status = jnp.where(over, 5, new_status)
         new_state = jnp.where(over, _ERR, new_state)
         emit_k = jnp.where(over, 0, emit_k)
         emitting = emit_k > 0
-        kmask = _mask_bits(emit_k << 3)
+        klo = jnp.minimum(emit_k, 4)
+        khi = jnp.maximum(emit_k - 4, 0)
+        kmask = _mask_bits(klo << 3)
+        kmask_hi = _mask_bits(khi << 3)
         bits = (packed & kmask) << ((off << 3).astype(_U32))
+        bits_hi = packed_hi & kmask_hi
         # big out: bytes land exactly once, buffer starts zeroed -> OR;
         # mask folded into the row (-1 matches nothing): pure one-hot,
-        # slab-wise to bound scoped-vmem temps
+        # slab-wise to bound scoped-vmem temps, and slab-gated on the
+        # live write window (lanes advance in rough lockstep, so most
+        # supersteps touch one slab, not all eight)
         wrow = jnp.where(emitting, outpos >> 2, -1)
+        wrow1 = jnp.where(emitting & (khi > 0), (outpos >> 2) + 1, -1)
+        wmin = jnp.min(jnp.where(wrow < 0, jnp.int32(ow), wrow))
+        wmax = jnp.maximum(jnp.max(wrow), jnp.max(wrow1))
         for s in range(0, ow, _SLAB):
             sl = min(_SLAB, ow - s)
-            cur = out_ref[s:s + sl, :]
-            out_ref[s:s + sl, :] = jnp.where(
-                _riota(sl) == wrow - s, cur | bits, cur)
-        # history ring: same word, replace-semantics (rows recycle)
+
+            @pl.when((wmax >= s) & (wmin < s + sl))
+            def _(s=s, sl=sl):
+                ri = _riota(sl)
+                cur = out_ref[s:s + sl, :]
+                nxt = jnp.where(ri == wrow - s, cur | bits, cur)
+                out_ref[s:s + sl, :] = jnp.where(
+                    ri == wrow1 - s, nxt | bits_hi, nxt)
+        # history ring: same words, replace-semantics (rows recycle)
         rrow = jnp.where(emitting, (outpos >> 2) & (RING_W - 1), -1)
+        rrow1 = jnp.where(emitting & (khi > 0),
+                          ((outpos >> 2) + 1) & (RING_W - 1), -1)
         curr = ring_ref[...]
         bmask = kmask << ((off << 3).astype(_U32))
+        rri = _riota(RING_W)
+        curr = jnp.where(rri == rrow, (curr & ~bmask) | bits, curr)
         ring_ref[...] = jnp.where(
-            _riota(RING_W) == rrow, (curr & ~bmask) | bits, curr)
+            rri == rrow1, (curr & ~kmask_hi) | bits_hi, curr)
         outpos = outpos + emit_k
 
         # ---- input-overrun guard ------------------------------------

@@ -61,7 +61,7 @@ from disq_tpu.ops.inflate_simd import (
     LANES,
     _bucket,
     _gather,
-    _gather_ref,
+    _gather_ref_win,
     _pack_chunk,
     _riota,
 )
@@ -97,7 +97,10 @@ def _rans0_simd_kernel(
         renorm consumes 8)."""
 
         def do(lo, mid, hi, cnt, in_w):
-            w = _gather_ref(comp_ref, jnp.minimum(in_w, cw - 1)).astype(_U32)
+            # windowed: lanes consume comp in near-lockstep, so the
+            # sweep usually touches one slab of the comp columns
+            w = _gather_ref_win(
+                comp_ref, jnp.minimum(in_w, cw - 1)).astype(_U32)
             do_l = cnt <= 64
             cu = (cnt & 31).astype(_U32)
             wlo = w << cu
