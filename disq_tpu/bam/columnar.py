@@ -28,7 +28,15 @@ def segment_gather(
     flat: np.ndarray, offsets: np.ndarray, indices: np.ndarray
 ) -> tuple[np.ndarray, np.ndarray]:
     """Gather ragged segments ``indices`` from (flat, offsets) into a new
-    (flat, offsets) pair. Fully vectorized (no per-record Python loop)."""
+    (flat, offsets) pair. Native per-segment memcpy when the C runtime
+    is available (~10x the numpy construction on the sort permute
+    path), else fully vectorized numpy (no per-record Python loop)."""
+    try:
+        from disq_tpu.native import segment_gather_native
+
+        return segment_gather_native(flat, offsets, indices)
+    except ImportError:
+        pass
     offsets = offsets.astype(np.int64)
     lens = np.diff(offsets)[indices]
     new_off = np.zeros(len(indices) + 1, dtype=np.int64)

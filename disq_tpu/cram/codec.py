@@ -447,7 +447,10 @@ class _Readers:
     """Per-content-id cursors + CORE bit cursor (decode side)."""
 
     def __init__(self, blocks: Dict[int, bytes], core: bytes = b""):
-        self.cur = {cid: Cursor(data) for cid, data in blocks.items()}
+        self.cur = {
+            cid: Cursor(data, itf8_table=True)
+            for cid, data in blocks.items()
+        }
         self.core = BitCursor(core or b"")
         self._huff_cache: Dict[int, object] = {}
 
@@ -518,9 +521,12 @@ class _Readers:
             stop, cid = enc.params
             c = self._c(cid)
             data = c.data
-            end = c.off
-            while data[end] != stop:
-                end += 1
+            try:
+                end = data.index(stop, c.off)   # C-speed scan
+            except AttributeError:              # memoryview has no index
+                end = c.off
+                while data[end] != stop:
+                    end += 1
             out = bytes(data[c.off:end])
             c.off = end + 1
             return out
@@ -980,12 +986,6 @@ def _decode_slice(
         seqs_l.append(seq)
         quals_l.append(np.frombuffer(quals, np.uint8))
         tags_l.append(np.frombuffer(join_tags(tag_entries), np.uint8))
-        # bin: recompute (CRAM does not store it)
-        span = sum(
-            (int(w) >> 4) for w in cigar_ops if (int(w) & 0xF) in (0, 2, 3, 7, 8)
-        )
-        end0 = max(pos0, 0) + max(span, 1)
-        bin_l[i] = int(reg2bin(max(pos0, 0), end0))
 
     def ragged(items, dtype):
         off = np.zeros(n + 1, dtype=np.int64)
@@ -1002,6 +1002,18 @@ def _decode_slice(
     seq_off, seqs_f = ragged(seqs_l, np.uint8)
     _, quals_f = ragged(quals_l, np.uint8)
     tag_off, tags_f = ragged(tags_l, np.uint8)
+    # bin: recompute (CRAM does not store it) — vectorized over the
+    # whole slice via a segment sum of reference-consuming CIGAR ops
+    # (M/D/N/=/X), not per record (was the hottest line of CRAM read)
+    ops4 = cigars_f & 0xF
+    consume = ((ops4 == 0) | (ops4 == 2) | (ops4 == 3)
+               | (ops4 == 7) | (ops4 == 8))
+    contrib = np.where(consume, cigars_f >> 4, 0).astype(np.int64)
+    ccum = np.zeros(len(cigars_f) + 1, dtype=np.int64)
+    np.cumsum(contrib, out=ccum[1:])
+    span = ccum[cigar_off[1:]] - ccum[cigar_off[:-1]]
+    beg = np.maximum(pos_l.astype(np.int64), 0)
+    bin_l = reg2bin(beg, beg + np.maximum(span, 1)).astype(bin_l.dtype)
     return ReadBatch(
         refid=refid_l, pos=pos_l, mapq=mapq_l, bin=bin_l, flag=flag_l,
         next_refid=nref_l, next_pos=npos_l, tlen=tlen_l,

@@ -13,6 +13,8 @@ from __future__ import annotations
 import struct
 from typing import List, Tuple
 
+import numpy as np
+
 
 def write_itf8(value: int) -> bytes:
     v = value & 0xFFFFFFFF
@@ -98,13 +100,62 @@ def read_ltf8(data, offset: int) -> Tuple[int, int]:
 
 
 class Cursor:
-    """Sequential reader over a bytes-like object."""
+    """Sequential reader over a bytes-like object.
 
-    def __init__(self, data, offset: int = 0):
+    Streams that pull many ITF8 values (CRAM data-series external
+    blocks read roughly one varint per record per series) opt in with
+    ``itf8_table=True`` and switch to a vectorized
+    decode-at-every-offset table after ``_ITF8_TABLE_AFTER`` scalar
+    reads: one numpy pass precomputes (value, length) for all byte
+    positions and each subsequent ``itf8()`` is two array indexes.
+    Header cursors (a handful of varints over a whole-container buffer,
+    where the O(len) build could never amortize) stay scalar."""
+
+    _ITF8_TABLE_AFTER = 16
+
+    def __init__(self, data, offset: int = 0, itf8_table: bool = False):
         self.data = data
         self.off = offset
+        self._v = None
+        self._nb = None
+        self._ni = 0 if itf8_table else -(1 << 60)
+
+    def _build_itf8_table(self) -> None:
+        # uint32 arithmetic wraps exactly like the scalar reader's
+        # masked shifts; .view(int32) restores the signed contract
+        a = np.frombuffer(self.data, np.uint8).astype(np.uint32)
+        n = len(a)
+        p = np.concatenate([a, np.zeros(4, np.uint32)])
+        b0 = p[:n]
+        b1, b2, b3, b4 = p[1:n + 1], p[2:n + 2], p[3:n + 3], p[4:n + 4]
+        conds = [b0 < 0x80, b0 < 0xC0, b0 < 0xE0, b0 < 0xF0]
+        v = np.select(conds, [
+            b0,
+            ((b0 & 0x7F) << 8) | b1,
+            ((b0 & 0x3F) << 16) | (b1 << 8) | b2,
+            ((b0 & 0x1F) << 24) | (b1 << 16) | (b2 << 8) | b3,
+        ], ((b0 & 0x0F) << 28) | (b1 << 20) | (b2 << 12) | (b3 << 4)
+           | (b4 & 0x0F))
+        self._v = v.view(np.int32)
+        self._nb = np.select(conds, [1, 2, 3, 4], 5).astype(np.uint8)
 
     def itf8(self) -> int:
+        v = self._v
+        if v is not None:
+            o = self.off
+            nb_arr = self._nb
+            if o >= len(v):
+                raise IndexError("ITF8 read past end of stream")
+            nb = int(nb_arr[o])
+            if o + nb > len(v):
+                # varint truncated at the stream end: the table decoded
+                # against zero padding — raise like the scalar reader
+                raise IndexError("truncated ITF8 at end of stream")
+            self.off = o + nb
+            return int(v[o])
+        self._ni += 1
+        if self._ni >= self._ITF8_TABLE_AFTER:
+            self._build_itf8_table()
         v, self.off = read_itf8(self.data, self.off)
         return v
 

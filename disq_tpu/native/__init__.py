@@ -103,6 +103,10 @@ def _bind(lib: ctypes.CDLL) -> None:
         u8p, i64p, ctypes.c_int64, i32p, i32p, u8p, u16p, u16p, i32p,
         i32p, i32p, i64p, u8p, i64p, u32p, i64p, u8p, u8p, i64p, u8p,
     ]
+    lib.disq_segment_gather.restype = ctypes.c_int64
+    lib.disq_segment_gather.argtypes = [
+        u8p, i64p, i64p, ctypes.c_int64, i64p, u8p, ctypes.c_int64,
+    ]
 
 
 def _load() -> ctypes.CDLL:
@@ -409,3 +413,35 @@ def deflate_blocks_native(
     if rc != 0:
         raise ValueError(f"BGZF deflate failed at block {rc - 1}")
     return out.reshape(nblocks, stride), sizes
+
+
+def segment_gather_native(
+    flat: np.ndarray, offsets: np.ndarray, indices: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged segment gather (per-segment C memcpy). Same contract as
+    ``bam.columnar.segment_gather``: returns (new_flat, new_offsets)."""
+    lib = _load()
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    nseg = len(offsets) - 1
+    if len(indices) and (
+        int(indices.min()) < -nseg or int(indices.max()) >= nseg
+    ):
+        raise IndexError("segment index out of range")
+    if len(indices) and int(indices.min()) < 0:
+        # numpy negative-index semantics; the C loop needs them absolute
+        indices = np.where(indices < 0, indices + nseg, indices)
+    lens = np.diff(offsets)[indices]
+    new_off = np.zeros(len(indices) + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_off[1:])
+    flat_c = np.ascontiguousarray(flat)
+    out = np.empty(int(new_off[-1]), dtype=flat_c.dtype)
+    lib.disq_segment_gather(
+        _ptr(flat_c.view(np.uint8), ctypes.c_uint8),
+        _ptr(offsets, ctypes.c_int64),
+        _ptr(indices, ctypes.c_int64), len(indices),
+        _ptr(new_off, ctypes.c_int64),
+        _ptr(out.view(np.uint8), ctypes.c_uint8),
+        flat_c.dtype.itemsize,
+    )
+    return out, new_off

@@ -361,3 +361,26 @@ class TestRansOrder1:
             raw.append(prev)
         raw = bytes(raw)
         assert len(self._encode_order1(raw)) < len(rans_encode_order0(raw))
+
+
+class TestCursorItf8Table:
+    def test_table_path_matches_scalar_reader(self):
+        # enough reads to trip the vectorized decode table, covering
+        # every byte-width class and the signed-int32 wrap
+        from disq_tpu.cram.io import Cursor, read_itf8, write_itf8
+
+        vals = [0, 1, 127, 128, 16383, 16384, 2097151, 2097152,
+                268435455, 268435456, (1 << 31) - 1, -1, -100,
+                -(1 << 31)] * 4
+        data = b"".join(write_itf8(v) for v in vals)
+        c = Cursor(data, itf8_table=True)
+        got = [c.itf8() for _ in range(len(vals))]
+        assert c._v is not None  # the table really engaged
+        # scalar reference
+        off, ref = 0, []
+        for _ in vals:
+            v, off = read_itf8(data, off)
+            ref.append(v)
+        assert got == ref
+        with pytest.raises(IndexError):
+            c.itf8()

@@ -84,3 +84,47 @@ class TestDeflateInflate:
         comp[blocks[1].pos + 20] ^= 0xFF
         with pytest.raises(ValueError):
             inflate_blocks(bytes(comp), blocks)
+
+
+class TestSegmentGatherNative:
+    def test_matches_numpy_reference(self):
+        segment_gather_native = native.segment_gather_native
+
+        rng = np.random.default_rng(0)
+        for t in range(30):
+            n = int(rng.integers(0, 200))
+            lens = rng.integers(0, 12, n)
+            off = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=off[1:])
+            for dt in (np.uint8, np.uint32):
+                flat = rng.integers(0, 250, int(off[-1])).astype(dt)
+                idx = (rng.permutation(n)[: int(rng.integers(0, n + 1))]
+                       if n else np.zeros(0, np.int64))
+                got_f, got_o = segment_gather_native(flat, off, idx)
+                # independent numpy reference (the pure fallback path)
+                l2 = np.diff(off)[idx]
+                ref_o = np.zeros(len(idx) + 1, np.int64)
+                np.cumsum(l2, out=ref_o[1:])
+                if int(ref_o[-1]):
+                    seg = np.repeat(np.arange(len(idx)), l2)
+                    within = (np.arange(int(ref_o[-1]), dtype=np.int64)
+                              - ref_o[seg])
+                    ref_f = flat[off[idx][seg] + within]
+                else:
+                    ref_f = flat[:0].copy()
+                assert got_f.dtype == flat.dtype
+                assert np.array_equal(got_f, ref_f), t
+                assert np.array_equal(got_o, ref_o), t
+
+    def test_negative_and_out_of_range_indices(self):
+        segment_gather_native = native.segment_gather_native
+
+        off = np.array([0, 2, 5, 9], np.int64)
+        flat = np.arange(9, dtype=np.uint8)
+        got_f, got_o = segment_gather_native(flat, off, np.array([-1, 0]))
+        assert got_f.tolist() == [5, 6, 7, 8, 0, 1]
+        assert got_o.tolist() == [0, 4, 6]
+        with pytest.raises(IndexError):
+            segment_gather_native(flat, off, np.array([3]))
+        with pytest.raises(IndexError):
+            segment_gather_native(flat, off, np.array([-4]))
