@@ -429,22 +429,17 @@ def sharded_sort_read_batch(batch, mesh: Optional[Mesh] = None,
     return coordinate_sort_batch(batch, use_mesh=False), order
 
 
-def sharded_coordinate_sort(
-    keys_np: np.ndarray,
-    mesh: Optional[Mesh] = None,
-    axis: str = "shards",
-    capacity_factor: float = 2.0,
-    max_retries: int = 3,
+def _keys_exchange_host_wrapper(
+    keys_np: np.ndarray, n_shards: int, put, run,
+    capacity_factor: float, max_retries: int,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Host convenience wrapper: u64 keys → (sorted keys, permutation).
-
-    Pads to shard-uniform shape, runs the device exchange, retries with a
-    doubled capacity on the (rare, skew-driven) overflow signal, and falls
-    back to one host argsort only if skew defeats ``max_retries``
-    capacity doublings.
-    """
-    mesh = mesh or make_mesh()
-    n_shards = mesh.shape[axis]
+    """Shared pad/splitter/retry/trim protocol around a keys-only sort
+    exchange. ``put(hi, lo, rows, s_hi, s_lo, per_shard)`` places the
+    padded host arrays on the mesh; ``run(args, cf)`` executes one
+    exchange and returns (hi, lo, rows, counts, ok). Retries with a
+    doubled capacity on the (rare, skew-driven) overflow signal, and
+    falls back to one host argsort only if skew defeats
+    ``max_retries`` capacity doublings."""
     n = len(keys_np)
     if n == 0:
         return keys_np.copy(), np.zeros(0, dtype=np.int64)
@@ -457,24 +452,14 @@ def sharded_coordinate_sort(
     rows_p[:n] = np.arange(n, dtype=np.uint32)
     splitters = sample_splitters(keys_np, n_shards)
     s_hi, s_lo = split_u64_keys(splitters)
-    shard2d = NamedSharding(mesh, P(axis, None))
-    repl = NamedSharding(mesh, P(None))
-    args = (
-        jax.device_put(hi_p.reshape(n_shards, per_shard), shard2d),
-        jax.device_put(lo_p.reshape(n_shards, per_shard), shard2d),
-        jax.device_put(rows_p.reshape(n_shards, per_shard), shard2d),
-        jax.device_put(s_hi, repl),
-        jax.device_put(s_lo, repl),
-    )
+    args = put(hi_p, lo_p, rows_p, s_hi, s_lo, per_shard)
     for _ in range(max_retries):
-        oh, ol, orows, counts, ok = sharded_sort_step(
-            *args, mesh=mesh, axis=axis, capacity_factor=capacity_factor
-        )
+        oh, ol, orows, counts, ok = run(args, capacity_factor)
         if bool(jnp.all(ok)):
-            oh_h = np.asarray(oh)
-            ol_h = np.asarray(ol)
-            or_h = np.asarray(orows)
-            cnt = np.asarray(counts)
+            oh_h = np.asarray(oh).reshape(n_shards, -1)
+            ol_h = np.asarray(ol).reshape(n_shards, -1)
+            or_h = np.asarray(orows).reshape(n_shards, -1)
+            cnt = np.asarray(counts).reshape(-1)
             out_keys = np.concatenate(
                 [
                     (oh_h[i, : cnt[i]].astype(np.uint64) << np.uint64(32))
@@ -489,3 +474,185 @@ def sharded_coordinate_sort(
         capacity_factor *= 2.0
     order = np.argsort(keys_np, kind="stable")
     return keys_np[order], order
+
+
+def sharded_coordinate_sort(
+    keys_np: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis: str = "shards",
+    capacity_factor: float = 2.0,
+    max_retries: int = 3,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host convenience wrapper: u64 keys → (sorted keys, permutation)
+    over the flat 1-D mesh exchange (protocol in
+    ``_keys_exchange_host_wrapper``)."""
+    mesh = mesh or make_mesh()
+    n_shards = mesh.shape[axis]
+
+    def put(hi_p, lo_p, rows_p, s_hi, s_lo, per_shard):
+        shard2d = NamedSharding(mesh, P(axis, None))
+        repl = NamedSharding(mesh, P(None))
+        return (
+            jax.device_put(hi_p.reshape(n_shards, per_shard), shard2d),
+            jax.device_put(lo_p.reshape(n_shards, per_shard), shard2d),
+            jax.device_put(rows_p.reshape(n_shards, per_shard), shard2d),
+            jax.device_put(s_hi, repl),
+            jax.device_put(s_lo, repl),
+        )
+
+    def run(args, cf):
+        return sharded_sort_step(*args, mesh=mesh, axis=axis,
+                                 capacity_factor=cf)
+
+    return _keys_exchange_host_wrapper(
+        keys_np, n_shards, put, run, capacity_factor, max_retries)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (DCN, ICI) exchange — the multi-host layering.
+
+
+def _sort_stage_2level(
+    hi, lo, rows, s_hi, s_lo, *, dcn_axis: str, ici_axis: str,
+    n_hosts: int, per_host: int, cap1: int, cap2: int,
+):
+    """Two-stage exchange body under shard_map over a (dcn, shards)
+    mesh (``runtime/multihost.global_mesh``): stage 1 groups keys by
+    destination HOST and exchanges over the DCN axis (each device talks
+    to its same-ordinal peer on every other host — n_hosts-1 large
+    messages instead of n_devices-1 small ones crossing the network);
+    stage 2 groups by destination device within the host and exchanges
+    over the ICI axis. Device (h, j) ends up holding global range chunk
+    h*per_host + j, so concatenation order matches the flat exchange.
+    """
+    n_shards = n_hosts * per_host
+    hi, lo, rows = hi.reshape(-1), lo.reshape(-1), rows.reshape(-1)
+
+    def group_scatter(bucket, nb, cap, arrs, fills):
+        order = jnp.argsort(bucket, stable=True)
+        b_g = bucket[order]
+        group_start = jnp.searchsorted(b_g, b_g, side="left")
+        within = jnp.arange(b_g.shape[0]) - group_start
+        outs = []
+        for a, fill in zip(arrs, fills):
+            buf = jnp.full((nb, cap), fill, dtype=a.dtype)
+            outs.append(buf.at[b_g, within].set(a[order], mode="drop"))
+        counts = jnp.bincount(
+            jnp.where(b_g < nb, b_g, 0),
+            weights=(b_g < nb).astype(jnp.int32), length=nb,
+        ).astype(jnp.int32)
+        return outs, counts
+
+    # ---- stage 1: to the owning host, over DCN -----------------------
+    valid = ~((hi == SENT32) & (lo == SENT32))
+    dest = jnp.where(valid, _dest_shard(hi, lo, s_hi, s_lo), n_shards)
+    dest_host = dest // per_host           # phantom -> n_hosts
+    (sh, sl, sr), c1 = group_scatter(
+        dest_host, n_hosts, cap1, (hi, lo, rows), (SENT32, SENT32, 0))
+    ok1 = (c1 <= cap1).all()
+    rh = lax.all_to_all(sh, dcn_axis, split_axis=0, concat_axis=0)
+    rl = lax.all_to_all(sl, dcn_axis, split_axis=0, concat_axis=0)
+    rr = lax.all_to_all(sr, dcn_axis, split_axis=0, concat_axis=0)
+    hi1, lo1, rows1 = rh.reshape(-1), rl.reshape(-1), rr.reshape(-1)
+
+    # ---- stage 2: to the owning device, over ICI ---------------------
+    valid1 = ~((hi1 == SENT32) & (lo1 == SENT32))
+    dest1 = jnp.where(
+        valid1, _dest_shard(hi1, lo1, s_hi, s_lo), n_shards)
+    my_host = lax.axis_index(dcn_axis)
+    local = jnp.where(
+        valid1, dest1 - my_host * per_host, per_host)  # phantom
+    (sh2, sl2, sr2), c2 = group_scatter(
+        local, per_host, cap2, (hi1, lo1, rows1), (SENT32, SENT32, 0))
+    ok2 = (c2 <= cap2).all()
+    rh2 = lax.all_to_all(sh2, ici_axis, split_axis=0, concat_axis=0)
+    rl2 = lax.all_to_all(sl2, ici_axis, split_axis=0, concat_axis=0)
+    rr2 = lax.all_to_all(sr2, ici_axis, split_axis=0, concat_axis=0)
+    fh, fl, fr = rh2.reshape(-1), rl2.reshape(-1), rr2.reshape(-1)
+    final = jnp.lexsort((fl, fh))
+    out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
+    n_valid = jnp.sum(
+        ~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)
+    # all-devices ok: reduce over both axes
+    ok = lax.psum(
+        lax.psum((~ok1 | ~ok2).astype(jnp.int32), dcn_axis), ici_axis) == 0
+    return (out_hi[None, None], out_lo[None, None], out_rows[None, None],
+            n_valid[None, None], ok[None, None])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "dcn_axis", "ici_axis", "capacity_factor"))
+def hierarchical_sort_step(
+    hi, lo, rows, s_hi, s_lo, *, mesh: Mesh,
+    dcn_axis: str = "dcn", ici_axis: str = "shards",
+    capacity_factor: float = 2.0,
+):
+    """One two-stage sort exchange over a (dcn, shards) mesh.
+
+    Inputs (n_hosts, per_host, per_shard), sharded over both mesh axes
+    on dims 0/1, sentinel-padded like ``sharded_sort_step``. Returns
+    (hi, lo, rows, valid_counts, ok) with the same global-order
+    concatenation contract as the flat exchange.
+    """
+    n_hosts = mesh.shape[dcn_axis]
+    per_host = mesh.shape[ici_axis]
+    per_shard = hi.shape[2]
+    cap1 = min(int(per_shard * capacity_factor / n_hosts) + 1, per_shard)
+    cap2 = min(int(per_shard * capacity_factor / per_host) + 1,
+               n_hosts * cap1)
+    body = functools.partial(
+        _sort_stage_2level, dcn_axis=dcn_axis, ici_axis=ici_axis,
+        n_hosts=n_hosts, per_host=per_host, cap1=cap1, cap2=cap2)
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(dcn_axis, ici_axis, None), P(dcn_axis, ici_axis, None),
+            P(dcn_axis, ici_axis, None), P(None), P(None),
+        ),
+        out_specs=(
+            P(dcn_axis, ici_axis, None), P(dcn_axis, ici_axis, None),
+            P(dcn_axis, ici_axis, None), P(dcn_axis, ici_axis),
+            P(dcn_axis, ici_axis),
+        ),
+    )(hi, lo, rows, s_hi, s_lo)
+
+
+def hierarchical_coordinate_sort(
+    keys_np: np.ndarray, mesh: Mesh,
+    dcn_axis: str = "dcn", ici_axis: str = "shards",
+    capacity_factor: float = 2.0, max_retries: int = 3,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """u64 keys → (sorted keys, permutation) over a (dcn, shards) mesh
+    (see ``runtime/multihost.global_mesh``). Same contract and retry
+    protocol as ``sharded_coordinate_sort``; the exchange runs in two
+    stages so inter-host traffic crosses DCN once, in host-sized
+    messages, and the fan-out to devices rides ICI."""
+    n_hosts = mesh.shape[dcn_axis]
+    per_host = mesh.shape[ici_axis]
+    n_shards = n_hosts * per_host
+
+    def put(hi_p, lo_p, rows_p, s_hi, s_lo, per_shard):
+        shard3d = NamedSharding(mesh, P(dcn_axis, ici_axis, None))
+        repl = NamedSharding(mesh, P())
+        shape3 = (n_hosts, per_host, per_shard)
+        return (
+            jax.device_put(hi_p.reshape(shape3), shard3d),
+            jax.device_put(lo_p.reshape(shape3), shard3d),
+            jax.device_put(rows_p.reshape(shape3), shard3d),
+            jax.device_put(s_hi, repl),
+            jax.device_put(s_lo, repl),
+        )
+
+    def run(args, cf):
+        return hierarchical_sort_step(
+            *args, mesh=mesh, dcn_axis=dcn_axis, ici_axis=ici_axis,
+            capacity_factor=cf)
+
+    return _keys_exchange_host_wrapper(
+        keys_np, n_shards, put, run, capacity_factor, max_retries)
