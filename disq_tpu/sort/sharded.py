@@ -78,6 +78,28 @@ def _dest_shard(hi, lo, s_hi, s_lo):
     return jnp.sum(le, axis=0, dtype=jnp.int32)
 
 
+def _group_scatter(bucket, nb, cap, arrs, fills):
+    """Group-by-destination scatter shared by every exchange stage:
+    stable-sort by bucket, rank within each group, scatter each array
+    into a fixed-capacity (nb, cap) send buffer (phantom bucket ``nb``
+    and over-capacity entries fall outside and are dropped), and return
+    the per-bucket valid counts for overflow detection."""
+    order = jnp.argsort(bucket, stable=True)
+    b_g = bucket[order]
+    group_start = jnp.searchsorted(b_g, b_g, side="left")
+    within = jnp.arange(b_g.shape[0]) - group_start
+    outs = []
+    for a, fill in zip(arrs, fills):
+        buf_shape = (nb, cap) + a.shape[1:]
+        buf = jnp.full(buf_shape, fill, dtype=a.dtype)
+        outs.append(buf.at[b_g, within].set(a[order], mode="drop"))
+    counts = jnp.bincount(
+        jnp.where(b_g < nb, b_g, 0),
+        weights=(b_g < nb).astype(jnp.int32), length=nb,
+    ).astype(jnp.int32)
+    return outs, counts
+
+
 def _sort_stage(hi, lo, rows, s_hi, s_lo, *, axis: str, n_shards: int, cap: int):
     """Per-shard body under shard_map. hi/lo/rows: (1, per_shard) blocks
     with sentinel padding; s_hi/s_lo: (n_shards-1,) replicated."""
@@ -87,33 +109,19 @@ def _sort_stage(hi, lo, rows, s_hi, s_lo, *, axis: str, n_shards: int, cap: int)
     # Invalid (padding) entries route to a phantom bucket n_shards so they
     # group after every real bucket and never inflate a real rank.
     dest = jnp.where(valid, dest, n_shards)
-    order = jnp.argsort(dest, stable=True)
-    dest_g = dest[order]
-    hi_g, lo_g, rows_g = hi[order], lo[order], rows[order]
-    valid_g = valid[order]
-    counts = jnp.bincount(
-        jnp.where(valid_g, dest_g, 0),
-        weights=valid_g.astype(jnp.int32),
-        length=n_shards,
-    ).astype(jnp.int32)
-    m = hi.shape[0]
-    group_start = jnp.searchsorted(dest_g, dest_g, side="left")
-    within = jnp.arange(m) - group_start
-    send_hi = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
-    send_lo = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
-    send_rows = jnp.zeros((n_shards, cap), dtype=rows.dtype)
-    # Phantom-bucket and over-capacity entries fall outside the buffer and
-    # are dropped by scatter mode="drop"; overflow is flagged below.
-    send_hi = send_hi.at[dest_g, within].set(hi_g, mode="drop")
-    send_lo = send_lo.at[dest_g, within].set(lo_g, mode="drop")
-    send_rows = send_rows.at[dest_g, within].set(rows_g, mode="drop")
+    (send_hi, send_lo, send_rows), counts = _group_scatter(
+        dest, n_shards, cap, (hi, lo, rows), (SENT32, SENT32, 0))
     ok = jnp.all(lax.psum((counts > cap).astype(jnp.int32), axis) == 0)
     # The exchange — rides ICI on real hardware.
     recv_hi = lax.all_to_all(send_hi, axis, split_axis=0, concat_axis=0)
     recv_lo = lax.all_to_all(send_lo, axis, split_axis=0, concat_axis=0)
     recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0)
     fh, fl, fr = recv_hi.reshape(-1), recv_lo.reshape(-1), recv_rows.reshape(-1)
-    final = jnp.lexsort((fl, fh))
+    # rows as the least-significant tie-break: duplicate keys keep
+    # original-index order on EVERY exchange shape (the hierarchical
+    # path's arrival order differs from the flat path's, so relying on
+    # arrival stability would make tie order topology-dependent)
+    final = jnp.lexsort((fr, fl, fh))
     out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
     n_valid = jnp.sum(~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)
     return out_hi[None], out_lo[None], out_rows[None], n_valid[None], ok[None]
@@ -167,26 +175,8 @@ def _sort_stage_payload(
     valid = ~((hi == SENT32) & (lo == SENT32))
     dest = _dest_shard(hi, lo, s_hi, s_lo)
     dest = jnp.where(valid, dest, n_shards)
-    order = jnp.argsort(dest, stable=True)
-    dest_g = dest[order]
-    hi_g, lo_g, rows_g, vals_g = hi[order], lo[order], rows[order], vals[order]
-    valid_g = valid[order]
-    counts = jnp.bincount(
-        jnp.where(valid_g, dest_g, 0),
-        weights=valid_g.astype(jnp.int32),
-        length=n_shards,
-    ).astype(jnp.int32)
-    m = hi.shape[0]
-    group_start = jnp.searchsorted(dest_g, dest_g, side="left")
-    within = jnp.arange(m) - group_start
-    send_hi = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
-    send_lo = jnp.full((n_shards, cap), SENT32, dtype=jnp.uint32)
-    send_rows = jnp.zeros((n_shards, cap), dtype=rows.dtype)
-    send_vals = jnp.zeros((n_shards, cap, w), dtype=vals.dtype)
-    send_hi = send_hi.at[dest_g, within].set(hi_g, mode="drop")
-    send_lo = send_lo.at[dest_g, within].set(lo_g, mode="drop")
-    send_rows = send_rows.at[dest_g, within].set(rows_g, mode="drop")
-    send_vals = send_vals.at[dest_g, within].set(vals_g, mode="drop")
+    (send_hi, send_lo, send_rows, send_vals), counts = _group_scatter(
+        dest, n_shards, cap, (hi, lo, rows, vals), (SENT32, SENT32, 0, 0))
     ok = jnp.all(lax.psum((counts > cap).astype(jnp.int32), axis) == 0)
     recv_hi = lax.all_to_all(send_hi, axis, split_axis=0, concat_axis=0)
     recv_lo = lax.all_to_all(send_lo, axis, split_axis=0, concat_axis=0)
@@ -194,7 +184,7 @@ def _sort_stage_payload(
     recv_vals = lax.all_to_all(send_vals, axis, split_axis=0, concat_axis=0)
     fh, fl, fr = recv_hi.reshape(-1), recv_lo.reshape(-1), recv_rows.reshape(-1)
     fv = recv_vals.reshape(-1, w)
-    final = jnp.lexsort((fl, fh))
+    final = jnp.lexsort((fr, fl, fh))
     out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
     out_vals = fv[final]
     n_valid = jnp.sum(~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)
@@ -528,26 +518,11 @@ def _sort_stage_2level(
     n_shards = n_hosts * per_host
     hi, lo, rows = hi.reshape(-1), lo.reshape(-1), rows.reshape(-1)
 
-    def group_scatter(bucket, nb, cap, arrs, fills):
-        order = jnp.argsort(bucket, stable=True)
-        b_g = bucket[order]
-        group_start = jnp.searchsorted(b_g, b_g, side="left")
-        within = jnp.arange(b_g.shape[0]) - group_start
-        outs = []
-        for a, fill in zip(arrs, fills):
-            buf = jnp.full((nb, cap), fill, dtype=a.dtype)
-            outs.append(buf.at[b_g, within].set(a[order], mode="drop"))
-        counts = jnp.bincount(
-            jnp.where(b_g < nb, b_g, 0),
-            weights=(b_g < nb).astype(jnp.int32), length=nb,
-        ).astype(jnp.int32)
-        return outs, counts
-
     # ---- stage 1: to the owning host, over DCN -----------------------
     valid = ~((hi == SENT32) & (lo == SENT32))
     dest = jnp.where(valid, _dest_shard(hi, lo, s_hi, s_lo), n_shards)
     dest_host = dest // per_host           # phantom -> n_hosts
-    (sh, sl, sr), c1 = group_scatter(
+    (sh, sl, sr), c1 = _group_scatter(
         dest_host, n_hosts, cap1, (hi, lo, rows), (SENT32, SENT32, 0))
     ok1 = (c1 <= cap1).all()
     rh = lax.all_to_all(sh, dcn_axis, split_axis=0, concat_axis=0)
@@ -562,14 +537,17 @@ def _sort_stage_2level(
     my_host = lax.axis_index(dcn_axis)
     local = jnp.where(
         valid1, dest1 - my_host * per_host, per_host)  # phantom
-    (sh2, sl2, sr2), c2 = group_scatter(
+    (sh2, sl2, sr2), c2 = _group_scatter(
         local, per_host, cap2, (hi1, lo1, rows1), (SENT32, SENT32, 0))
     ok2 = (c2 <= cap2).all()
     rh2 = lax.all_to_all(sh2, ici_axis, split_axis=0, concat_axis=0)
     rl2 = lax.all_to_all(sl2, ici_axis, split_axis=0, concat_axis=0)
     rr2 = lax.all_to_all(sr2, ici_axis, split_axis=0, concat_axis=0)
     fh, fl, fr = rh2.reshape(-1), rl2.reshape(-1), rr2.reshape(-1)
-    final = jnp.lexsort((fl, fh))
+    # rows tie-break: the two-stage arrival order differs from the flat
+    # exchange's, so duplicate keys MUST be ordered by original index
+    # here or multi-host output would diverge from single-host output
+    final = jnp.lexsort((fr, fl, fh))
     out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
     n_valid = jnp.sum(
         ~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)

@@ -121,3 +121,24 @@ class TestHierarchicalSort:
         keys = rng.integers(0, 1 << 40, 999, dtype=np.uint64)
         got, _ = hierarchical_coordinate_sort(keys, self._mesh(1, 8))
         np.testing.assert_array_equal(got, np.sort(keys))
+
+    def test_duplicate_key_tie_order_matches_flat(self):
+        # duplicate coordinates are the norm in real BAM; ties must
+        # come back in original-index order on BOTH exchange shapes or
+        # multi-host output would diverge from single-host output
+        import numpy as np
+        from disq_tpu.sort.sharded import (
+            hierarchical_coordinate_sort,
+            sharded_coordinate_sort,
+        )
+
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 50, 3000, dtype=np.uint64)  # heavy ties
+        flat_keys, flat_perm = sharded_coordinate_sort(keys)
+        hier_keys, hier_perm = hierarchical_coordinate_sort(
+            keys, self._mesh(2, 4))
+        np.testing.assert_array_equal(flat_keys, hier_keys)
+        np.testing.assert_array_equal(flat_perm, hier_perm)
+        # and both equal the stable host argsort
+        np.testing.assert_array_equal(
+            flat_perm, np.argsort(keys, kind="stable"))
