@@ -214,3 +214,30 @@ class TestEndToEnd:
         data[blocks[0].pos + blocks[0].csize - 8] ^= 0xFF
         with pytest.raises(ValueError, match="CRC mismatch"):
             inflate_blocks_device(bytes(data), blocks)
+
+
+class TestCopyWidthBoundaries:
+    def test_every_match_distance_1_to_24(self):
+        # periodic data with period d makes zlib emit distance-d copies,
+        # sweeping the 4-byte / 8-byte (d >= 8) / 16-byte (d >= 16)
+        # emit-width eligibility boundaries and the d < 4 modular
+        # replication, at every alignment the partial first steps create
+        raws, payloads = [], []
+        for d in range(1, 25):
+            unit = bytes((7 * i + d) % 251 for i in range(d))
+            raw = (unit * (3000 // d + 2))[:3000]
+            raws.append(raw)
+            payloads.append(deflate(raw))
+        check(payloads, raws)
+
+    def test_copy_tails_5_to_16_bytes(self):
+        # matches whose final step emits 5..16 bytes: literal prefix
+        # breaks alignment, then a long match ends mid-word
+        raws, payloads = [], []
+        for pre in range(1, 5):
+            for tail in range(5, 17):
+                unit = bytes((3 * i + pre) % 256 for i in range(32))
+                raw = bytes(range(pre)) + (unit * 8)[: 32 * 4 + tail]
+                raws.append(raw)
+                payloads.append(deflate(raw, 9))
+        check(payloads, raws)

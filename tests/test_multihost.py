@@ -112,6 +112,8 @@ class TestHierarchicalSort:
         k1, p1 = hierarchical_coordinate_sort(
             np.array([7, 3, 5], np.uint64), self._mesh(2, 4))
         np.testing.assert_array_equal(k1, [3, 5, 7])
+        np.testing.assert_array_equal(
+            np.array([7, 3, 5], np.uint64)[p1], k1)
 
     def test_single_host_degenerates(self):
         import numpy as np
