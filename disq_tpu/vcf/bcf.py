@@ -196,20 +196,46 @@ class _Reader:
             s = self.buf[self.p: self.p + n]
             self.p += n
             return t, s
+        p = self.p
+        if n == 1 and t in (_T_INT8, _T_INT16, _T_INT32):
+            # scalar fast path — the overwhelmingly common case
+            # (INFO values, dictionary keys): skip format-string struct
+            return t, [self._scalar_int(t)]
         if t == _T_FLOAT:
-            vals = list(struct.unpack_from(f"<{n}I", self.buf, self.p))
-            self.p += 4 * n
+            vals = list(struct.unpack_from(f"<{n}I", self.buf, p))
+            self.p = p + 4 * n
             return t, vals
         fmt = {_T_INT8: "b", _T_INT16: "h", _T_INT32: "i"}[t]
-        vals = list(struct.unpack_from(f"<{n}{fmt}", self.buf, self.p))
-        self.p += n * {_T_INT8: 1, _T_INT16: 2, _T_INT32: 4}[t]
+        vals = list(struct.unpack_from(f"<{n}{fmt}", self.buf, p))
+        self.p = p + n * {_T_INT8: 1, _T_INT16: 2, _T_INT32: 4}[t]
         return t, vals
 
+    def _scalar_int(self, t: int) -> int:
+        """Bounds-checked scalar int at the cursor (shared by both fast
+        paths — a truncated buffer must raise like struct did, not
+        decode a short slice to garbage)."""
+        p = self.p
+        w = 1 if t == _T_INT8 else (2 if t == _T_INT16 else 4)
+        if p + w > len(self.buf):
+            raise ValueError("truncated BCF typed value")
+        self.p = p + w
+        if t == _T_INT8:
+            v = self.buf[p]
+            return v - 256 if v >= 128 else v
+        return int.from_bytes(self.buf[p: p + w], "little", signed=True)
+
     def typed_int(self) -> int:
-        t, vals = self.typed_values()
-        if t not in (_T_INT8, _T_INT16, _T_INT32) or len(vals) != 1:
-            raise ValueError("expected typed scalar int")
-        return int(vals[0])
+        """Descriptor + one scalar int, without the list round-trip
+        (dictionary keys — the hottest typed read in record decode)."""
+        d = self.u8()
+        t, n = d & 0x0F, d >> 4
+        if n != 1 or t not in (_T_INT8, _T_INT16, _T_INT32):
+            self.p -= 1
+            t, vals = self.typed_values()
+            if t not in (_T_INT8, _T_INT16, _T_INT32) or len(vals) != 1:
+                raise ValueError("expected typed scalar int")
+            return int(vals[0])
+        return self._scalar_int(t)
 
 
 def _fmt_f32(v: float) -> str:

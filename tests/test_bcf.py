@@ -260,3 +260,22 @@ class TestStorageApi:
             f.write(compress_to_bgzf(b"not a bcf at all"))
         with pytest.raises(ValueError, match="magic|BCF"):
             VariantsStorage.make_default().read(p)
+
+
+def test_truncated_typed_value_raises():
+    # a typed scalar cut off at the buffer end must raise, not decode a
+    # short slice to a garbage small int (fast-path bounds contract)
+    from disq_tpu.vcf.bcf import _Reader, _T_INT16, _T_INT32
+
+    r = _Reader(b"\x01", 0)  # 1 byte left, INT16 needs 2
+    r_t = _Reader(bytes([0x12, 0x01]), 0)  # descriptor says INT16 x1
+    import pytest
+
+    with pytest.raises(ValueError, match="truncated"):
+        r._scalar_int(_T_INT16)
+    with pytest.raises(ValueError, match="truncated"):
+        r_t.typed_int()
+    r2 = _Reader(bytes([0x13, 0x01, 0x02]), 0)  # INT32 x1, 2 bytes left
+    with pytest.raises(ValueError, match="truncated"):
+        r2.typed_values()
+    assert _Reader(bytes([0x13, 1, 0, 0, 0]), 0).typed_int() == 1
