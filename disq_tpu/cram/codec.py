@@ -818,6 +818,60 @@ def decode_container_records(
     return ReadBatch.concat(batches)
 
 
+_FIXED_SERIES = ("BF", "CF", "RL", "AP", "RG", "MF", "NS", "NP", "TS",
+                 "TL", "FN", "MQ")
+
+
+def _enc_cids(e: Encoding) -> List[int]:
+    """External block ids an encoding reads from (nested for LEN)."""
+    if e.codec == E_EXTERNAL:
+        return [e.params]
+    if e.codec == E_BYTE_ARRAY_STOP:
+        return [e.params[1]]
+    if e.codec == E_BYTE_ARRAY_LEN:
+        return _enc_cids(e.params[0]) + _enc_cids(e.params[1])
+    return []
+
+
+def _bulk_fixed_series(rd, comp, enc, n, multi_ref):
+    """Pre-decode the fixed one-value-per-record series into plain
+    lists when each is EXTERNAL over its own block (shared or exotic
+    layouts fall back to the per-record loop — returns None). A stream
+    shorter than n values (e.g. a foreign file whose mate fields are
+    not one-per-record) also falls back, so the loop path reports the
+    real error."""
+    fixed = _FIXED_SERIES + (("RI",) if multi_ref else ())
+    if not all(s in enc and enc[s].codec == E_EXTERNAL for s in fixed):
+        return None
+    cids = [enc[s].params for s in fixed]
+    if len(set(cids)) != len(cids):
+        return None
+    others: List[int] = []
+    for k, e in enc.items():
+        if k not in fixed:
+            others += _enc_cids(e)
+    for e in comp.tag_enc.values():
+        others += _enc_cids(e)
+    if set(cids) & set(others):
+        return None
+    if not all(cid in rd.cur for cid in cids):
+        return None
+    # RG and MF are consumed-and-discarded by the loop; their blocks
+    # are exclusive (checked above) and per-slice, so the fast path
+    # need not walk them at all
+    decoded = [s for s in fixed if s not in ("RG", "MF")]
+    curs = [rd.cur[enc[s].params] for s in decoded]
+    saved = [c.off for c in curs]
+    try:
+        return {s: c.itf8_bulk(n) for s, c in zip(decoded, curs)}
+    except IndexError:
+        # rewind every partially-consumed cursor so the loop path
+        # re-reads from the true positions and reports the real error
+        for c, o in zip(curs, saved):
+            c.off = o
+        return None
+
+
 def _decode_slice(
     slice_hdr, comp: CompressionHeader, blocks: Dict[int, bytes], core,
     ref_fetch,
@@ -842,32 +896,55 @@ def _decode_slice(
     bin_l = np.zeros(n, np.uint16)
     names, cigars_l, seqs_l, quals_l, tags_l = [], [], [], [], []
 
+    # Columnar fast path: when every fixed per-record series is
+    # EXTERNAL with its own block (the htslib/our-writer layout), pull
+    # each series' whole value stream in one fused walk and index
+    # arrays in the loop, instead of 12 read_int dispatches per record.
+    # The value order within each block is identical to the loop's
+    # consumption order because these series are one-value-per-record.
+    cols = _bulk_fixed_series(rd, comp, enc, n, multi_ref)
+    if cols is not None and comp.ap_delta:
+        ap_cum = slice_hdr.ref_start + np.cumsum(
+            np.asarray(cols["AP"], np.int64))
+        cols["AP"] = ap_cum.tolist()
+
     for i in range(n):
-        flag = rd.read_int(enc["BF"])
-        cf = rd.read_int(enc["CF"])
-        rl = rd.read_int(enc["RL"])
-        if multi_ref:
-            refid_l[i] = rd.read_int(enc["RI"])
-        ap = rd.read_int(enc["AP"])
-        if comp.ap_delta:
-            ap = prev_ap + ap
-            prev_ap = ap
-        rd.read_int(enc["RG"])
+        if cols is not None:
+            flag = cols["BF"][i]
+            cf = cols["CF"][i]
+            rl = cols["RL"][i]
+            if multi_ref:
+                refid_l[i] = cols["RI"][i]
+            ap = cols["AP"][i]
+        else:
+            flag = rd.read_int(enc["BF"])
+            cf = rd.read_int(enc["CF"])
+            rl = rd.read_int(enc["RL"])
+            if multi_ref:
+                refid_l[i] = rd.read_int(enc["RI"])
+            ap = rd.read_int(enc["AP"])
+            if comp.ap_delta:
+                ap = prev_ap + ap
+                prev_ap = ap
+            rd.read_int(enc["RG"])
         name = rd.read_array(enc["RN"]) if comp.rn_preserved else b""
-        if cf & CF_DETACHED:
+        if not (cf & CF_DETACHED):
+            raise ValueError("only detached mate records supported")
+        if cols is not None:
+            ns, np_, ts = cols["NS"][i], cols["NP"][i], cols["TS"][i]
+            tl = cols["TL"][i]
+        else:
             rd.read_int(enc["MF"])
             ns = rd.read_int(enc["NS"])
             np_ = rd.read_int(enc["NP"])
             ts = rd.read_int(enc["TS"])
-        else:
-            raise ValueError("only detached mate records supported")
-        tl = rd.read_int(enc["TL"])
+            tl = rd.read_int(enc["TL"])
         tag_entries = []
         for key in comp.tag_lines[tl]:
             val = rd.read_array(comp.tag_enc[key])
             tag_entries.append((key, val))
         # features (MQ follows them — CRAM 3.0 record layout)
-        fn = rd.read_int(enc["FN"])
+        fn = cols["FN"][i] if cols is not None else rd.read_int(enc["FN"])
         features = []
         fpos = 0
         for _ in range(fn):
@@ -890,7 +967,7 @@ def _decode_slice(
             else:
                 raise ValueError(f"unsupported read feature {code!r}")
             features.append((fpos, code, payload))
-        mq = rd.read_int(enc["MQ"])
+        mq = cols["MQ"][i] if cols is not None else rd.read_int(enc["MQ"])
         quals = rd.read_bytes_len(enc["QS"], rl) if cf & CF_QS_STORED else b"\xff" * rl
 
         # reconstruct seq + cigar

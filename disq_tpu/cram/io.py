@@ -159,6 +159,34 @@ class Cursor:
         v, self.off = read_itf8(self.data, self.off)
         return v
 
+    def itf8_bulk(self, count: int) -> List[int]:
+        """``count`` sequential ITF8 values in one fused walk over the
+        decode table (the CRAM columnar fast path pulls whole
+        per-series value streams with this). Raises IndexError past the
+        stream end, like ``itf8``."""
+        if count <= 0:
+            return []
+        if self._v is None:
+            self._build_itf8_table()
+        # the walk touches most of the stream, so list conversion
+        # amortizes and python-list indexing beats numpy scalar reads
+        vl = self._v.tolist()
+        nbl = self._nb.tolist()
+        ln = len(vl)
+        off = self.off
+        out = []
+        ap = out.append
+        for _ in range(count):
+            if off >= ln:
+                raise IndexError("ITF8 read past end of stream")
+            w = nbl[off]
+            if off + w > ln:
+                raise IndexError("truncated ITF8 at end of stream")
+            ap(vl[off])
+            off += w
+        self.off = off
+        return out
+
     def ltf8(self) -> int:
         v, self.off = read_ltf8(self.data, self.off)
         return v
