@@ -833,6 +833,18 @@ def _enc_cids(e: Encoding) -> List[int]:
     return []
 
 
+def _external_cids_excluding(comp, enc, exclude) -> List[int]:
+    """External block ids consumed by every encoding EXCEPT the named
+    series — the exclusivity scan both bulk fast paths share."""
+    used: List[int] = []
+    for k, e in enc.items():
+        if k not in exclude:
+            used += _enc_cids(e)
+    for e in comp.tag_enc.values():
+        used += _enc_cids(e)
+    return used
+
+
 def _bulk_fixed_series(rd, comp, enc, n, multi_ref):
     """Pre-decode the fixed one-value-per-record series into plain
     lists when each is EXTERNAL over its own block (shared or exotic
@@ -846,13 +858,7 @@ def _bulk_fixed_series(rd, comp, enc, n, multi_ref):
     cids = [enc[s].params for s in fixed]
     if len(set(cids)) != len(cids):
         return None
-    others: List[int] = []
-    for k, e in enc.items():
-        if k not in fixed:
-            others += _enc_cids(e)
-    for e in comp.tag_enc.values():
-        others += _enc_cids(e)
-    if set(cids) & set(others):
+    if set(cids) & set(_external_cids_excluding(comp, enc, set(fixed))):
         return None
     if not all(cid in rd.cur for cid in cids):
         return None
@@ -870,6 +876,29 @@ def _bulk_fixed_series(rd, comp, enc, n, multi_ref):
         for c, o in zip(curs, saved):
             c.off = o
         return None
+
+
+def _bulk_split_names(rd, comp, enc, n) -> Optional[List[bytes]]:
+    """All n read names in one C-speed split when RN is a stop-byte
+    array over a block no other encoding reads; None → per-record
+    reads."""
+    if not comp.rn_preserved:
+        return None
+    rne = enc.get("RN")
+    if rne is None or rne.codec != E_BYTE_ARRAY_STOP:
+        return None
+    stop, cid = rne.params
+    if cid in _external_cids_excluding(comp, enc, ("RN",)):
+        return None
+    c = rd.cur.get(cid)
+    if c is None:
+        return None
+    segs = bytes(c.data[c.off:]).split(bytes([stop]))
+    if len(segs) < n + 1:
+        return None   # fewer names than records: loop path reports it
+    segs = segs[:n]
+    c.off += sum(len(s) for s in segs) + n
+    return segs
 
 
 def _decode_slice(
@@ -894,7 +923,15 @@ def _decode_slice(
     npos_l = np.empty(n, np.int32)
     tlen_l = np.empty(n, np.int32)
     bin_l = np.zeros(n, np.uint16)
-    names, cigars_l, seqs_l, quals_l, tags_l = [], [], [], [], []
+    # flat byte accumulators + per-record lengths (one frombuffer per
+    # column at the end instead of n tiny arrays + concatenate)
+    names, seqs_l, quals_l, tags_l = (
+        bytearray(), bytearray(), bytearray(), bytearray())
+    name_lens: List[int] = []
+    cig_flat: List[int] = []
+    cig_lens: List[int] = []
+    seq_lens: List[int] = []
+    tag_lens: List[int] = []
 
     # Columnar fast path: when every fixed per-record series is
     # EXTERNAL with its own block (the htslib/our-writer layout), pull
@@ -907,6 +944,8 @@ def _decode_slice(
         ap_cum = slice_hdr.ref_start + np.cumsum(
             np.asarray(cols["AP"], np.int64))
         cols["AP"] = ap_cum.tolist()
+    rn_names = _bulk_split_names(rd, comp, enc, n) if cols is not None \
+        else None
 
     for i in range(n):
         if cols is not None:
@@ -927,7 +966,10 @@ def _decode_slice(
                 ap = prev_ap + ap
                 prev_ap = ap
             rd.read_int(enc["RG"])
-        name = rd.read_array(enc["RN"]) if comp.rn_preserved else b""
+        if rn_names is not None:
+            name = rn_names[i]
+        else:
+            name = rd.read_array(enc["RN"]) if comp.rn_preserved else b""
         if not (cf & CF_DETACHED):
             raise ValueError("only detached mate records supported")
         if cols is not None:
@@ -1058,27 +1100,36 @@ def _decode_slice(
         nref_l[i] = ns
         npos_l[i] = np_ - 1
         tlen_l[i] = ts
-        names.append(np.frombuffer(name, np.uint8))
-        cigars_l.append(np.asarray(cigar_ops, dtype=np.uint32))
-        seqs_l.append(seq)
-        quals_l.append(np.frombuffer(quals, np.uint8))
-        tags_l.append(np.frombuffer(join_tags(tag_entries), np.uint8))
+        names += name
+        name_lens.append(len(name))
+        cig_flat.extend(cigar_ops)
+        cig_lens.append(len(cigar_ops))
+        seqs_l += seq.data
+        seq_lens.append(rl)
+        quals_l += quals     # always length rl — seq_lens covers both
+        tb = join_tags(tag_entries)
+        tags_l += tb
+        tag_lens.append(len(tb))
 
-    def ragged(items, dtype):
+    def ragged(lens, buf, dtype):
         off = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum([len(x) for x in items], out=off[1:])
-        flat = (
-            np.concatenate(items).astype(dtype)
-            if n and off[-1]
-            else np.zeros(0, dtype=dtype)
-        )
+        if lens:
+            np.cumsum(lens, out=off[1:])
+        # frombuffer over the bytearray directly: no second whole-column
+        # copy; the accumulator is never mutated after this point
+        flat = (np.frombuffer(buf, dtype) if len(buf)
+                else np.zeros(0, dtype=dtype))
         return off, flat
 
-    name_off, names_f = ragged(names, np.uint8)
-    cigar_off, cigars_f = ragged(cigars_l, np.uint32)
-    seq_off, seqs_f = ragged(seqs_l, np.uint8)
-    _, quals_f = ragged(quals_l, np.uint8)
-    tag_off, tags_f = ragged(tags_l, np.uint8)
+    name_off, names_f = ragged(name_lens, names, np.uint8)
+    seq_off, seqs_f = ragged(seq_lens, seqs_l, np.uint8)
+    quals_f = (np.frombuffer(quals_l, np.uint8) if len(quals_l)
+               else np.zeros(0, np.uint8))
+    tag_off, tags_f = ragged(tag_lens, tags_l, np.uint8)
+    cigar_off = np.zeros(n + 1, dtype=np.int64)
+    if cig_lens:
+        np.cumsum(cig_lens, out=cigar_off[1:])
+    cigars_f = np.asarray(cig_flat, dtype=np.uint32)
     # bin: recompute (CRAM does not store it) — vectorized over the
     # whole slice via a segment sum of reference-consuming CIGAR ops
     # (M/D/N/=/X), not per record (was the hottest line of CRAM read)
