@@ -36,7 +36,7 @@ import numpy as np
 
 from disq_tpu.bam.columnar import ReadBatch, SEQ_NT16
 from disq_tpu.cram.io import Cursor, write_itf8
-from disq_tpu.index.bai import reg2bin
+from disq_tpu.index.bai import bins_from_cigars
 
 # Encoding codec ids (CRAM 3.0 §12)
 E_EXTERNAL = 1
@@ -1131,17 +1131,8 @@ def _decode_slice(
         np.cumsum(cig_lens, out=cigar_off[1:])
     cigars_f = np.asarray(cig_flat, dtype=np.uint32)
     # bin: recompute (CRAM does not store it) — vectorized over the
-    # whole slice via a segment sum of reference-consuming CIGAR ops
-    # (M/D/N/=/X), not per record (was the hottest line of CRAM read)
-    ops4 = cigars_f & 0xF
-    consume = ((ops4 == 0) | (ops4 == 2) | (ops4 == 3)
-               | (ops4 == 7) | (ops4 == 8))
-    contrib = np.where(consume, cigars_f >> 4, 0).astype(np.int64)
-    ccum = np.zeros(len(cigars_f) + 1, dtype=np.int64)
-    np.cumsum(contrib, out=ccum[1:])
-    span = ccum[cigar_off[1:]] - ccum[cigar_off[:-1]]
-    beg = np.maximum(pos_l.astype(np.int64), 0)
-    bin_l = reg2bin(beg, beg + np.maximum(span, 1)).astype(bin_l.dtype)
+    # whole slice, shared with the SAM text parser
+    bin_l = bins_from_cigars(cigars_f, cigar_off, pos_l).astype(bin_l.dtype)
     return ReadBatch(
         refid=refid_l, pos=pos_l, mapq=mapq_l, bin=bin_l, flag=flag_l,
         next_refid=nref_l, next_pos=npos_l, tlen=tlen_l,

@@ -54,6 +54,24 @@ def reg2bin(beg, end) -> np.ndarray:
     return out.astype(np.uint32)
 
 
+def bins_from_cigars(cigars_f, cigar_offsets, pos) -> np.ndarray:
+    """Record bins for a whole batch from flat CIGAR words + offsets:
+    segment-sum the reference-consuming ops (M/D/N/=/X) into per-record
+    spans and reg2bin them. The one implementation shared by every
+    codec that must recompute bin (SAM text parse, CRAM decode — the
+    per-record scalar version was the hottest line of both)."""
+    cigars_f = np.asarray(cigars_f)
+    ops4 = cigars_f & 0xF
+    consume = ((ops4 == 0) | (ops4 == 2) | (ops4 == 3)
+               | (ops4 == 7) | (ops4 == 8))
+    contrib = np.where(consume, cigars_f >> 4, 0).astype(np.int64)
+    ccum = np.zeros(len(cigars_f) + 1, dtype=np.int64)
+    np.cumsum(contrib, out=ccum[1:])
+    span = ccum[cigar_offsets[1:]] - ccum[cigar_offsets[:-1]]
+    beg = np.maximum(np.asarray(pos, np.int64), 0)
+    return reg2bin(beg, beg + np.maximum(span, 1))
+
+
 def reg2bins(beg: int, end: int) -> List[int]:
     """All bins overlapping [beg, end) — the query-side companion."""
     end -= 1

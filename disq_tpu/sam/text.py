@@ -17,7 +17,7 @@ import numpy as np
 
 from disq_tpu.bam.columnar import CIGAR_OPS, SEQ_NT16, ReadBatch
 from disq_tpu.bam.header import SamHeader
-from disq_tpu.index.bai import reg2bin
+from disq_tpu.index.bai import bins_from_cigars
 
 _NT16_IDX = {c: i for i, c in enumerate(SEQ_NT16)}
 _NT16_IDX.update({c.lower(): i for c, i in list(_NT16_IDX.items())})
@@ -153,7 +153,7 @@ def batch_to_sam_lines(batch: ReadBatch, header: SamHeader) -> List[str]:
 
 def sam_lines_to_batch(lines: Iterable[str], header: SamHeader) -> ReadBatch:
     refid_l, pos_l, mapq_l, flag_l = [], [], [], []
-    nref_l, npos_l, tlen_l, bin_l = [], [], [], []
+    nref_l, npos_l, tlen_l = [], [], []
     names, cigars, seqs, quals, tags = [], [], [], [], []
     for line in lines:
         line = line.rstrip("\n")
@@ -191,11 +191,6 @@ def sam_lines_to_batch(lines: Iterable[str], header: SamHeader) -> ReadBatch:
                 np.frombuffer(f[10].encode(), dtype=np.uint8) - 33
             )
         tags.append(text_to_tags(f[11:]))
-        ref_span = sum(
-            (op >> 4) for op in ops if (op & 0xF) in (0, 2, 3, 7, 8)
-        )
-        end = pos + max(ref_span, 1)
-        bin_l.append(int(reg2bin(max(pos, 0), max(end, 1))))
 
     n = len(names)
 
@@ -214,9 +209,13 @@ def sam_lines_to_batch(lines: Iterable[str], header: SamHeader) -> ReadBatch:
     seq_off, seqs_f = ragged(seqs, np.uint8)
     _, quals_f = ragged(quals, np.uint8)
     tag_off, tags_f = ragged([np.frombuffer(t, np.uint8) for t in tags], np.uint8)
+    # bin: vectorized over the whole batch (per-record scalar reg2bin
+    # was the hottest line of SAM parse, exactly as for CRAM decode)
+    bin_arr = bins_from_cigars(cigars_f, cigar_off, pos_l)
     return ReadBatch(
         refid=np.asarray(refid_l, np.int32), pos=np.asarray(pos_l, np.int32),
-        mapq=np.asarray(mapq_l, np.uint8), bin=np.asarray(bin_l, np.uint16),
+        mapq=np.asarray(mapq_l, np.uint8),
+        bin=bin_arr.astype(np.uint16),
         flag=np.asarray(flag_l, np.uint16),
         next_refid=np.asarray(nref_l, np.int32),
         next_pos=np.asarray(npos_l, np.int32),
