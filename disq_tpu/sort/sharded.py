@@ -150,12 +150,7 @@ def sharded_sort_step(
     per_shard = hi.shape[1]
     cap = min(int(per_shard * capacity_factor / n_shards) + 1, per_shard)
     body = functools.partial(_sort_stage, axis=axis, n_shards=n_shards, cap=cap)
-    try:
-        from jax import shard_map  # jax >= 0.6 location
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
-    return shard_map(
+    return _shard_map()(
         body,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None), P(None), P(None)),
@@ -207,12 +202,7 @@ def sharded_sort_payload_step(
     body = functools.partial(
         _sort_stage_payload, axis=axis, n_shards=n_shards, cap=cap
     )
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
-    return shard_map(
+    return _shard_map()(
         body,
         mesh=mesh,
         in_specs=(
@@ -325,7 +315,21 @@ def sharded_sort_read_batch(batch, mesh: Optional[Mesh] = None,
     from disq_tpu.sort.coordinate import coordinate_keys
 
     mesh = mesh or make_mesh()
-    n_shards = mesh.shape[axis]
+    # a two-axis mesh (runtime/multihost.global_mesh's (dcn, shards))
+    # routes through the hierarchical two-stage exchange; the contract
+    # is explicit: the trailing axis (named by ``axis``) is ICI, the
+    # leading one is the DCN/host boundary — a swapped mesh would
+    # silently invert the bandwidth layering
+    two_level = len(mesh.axis_names) == 2
+    if two_level:
+        if mesh.axis_names[-1] != axis:
+            raise ValueError(
+                f"two-axis mesh must be (dcn_axis, {axis!r}) with the "
+                f"per-host ICI axis last; got {mesh.axis_names}")
+        dcn_axis, ici_axis = mesh.axis_names
+        n_shards = mesh.shape[dcn_axis] * mesh.shape[ici_axis]
+    else:
+        n_shards = mesh.shape[axis]
     n = batch.count
     if n == 0:
         return batch, np.zeros(0, dtype=np.int64)
@@ -363,30 +367,43 @@ def sharded_sort_read_batch(batch, mesh: Optional[Mesh] = None,
         vals_p[:n, 7 + s] = lens[s].astype(np.uint32)
     splitters = sample_splitters(keys, n_shards)
     s_hi, s_lo = split_u64_keys(splitters)
-    shard2d = NamedSharding(mesh, P(axis, None))
-    shard3d = NamedSharding(mesh, P(axis, None, None))
-    repl = NamedSharding(mesh, P(None))
+    if two_level:
+        kshape = (mesh.shape[dcn_axis], mesh.shape[ici_axis], per_shard)
+        shard_k = NamedSharding(mesh, P(dcn_axis, ici_axis, None))
+        shard_v = NamedSharding(mesh, P(dcn_axis, ici_axis, None, None))
+        repl = NamedSharding(mesh, P())
+        step = functools.partial(
+            hierarchical_sort_payload_step, mesh=mesh,
+            dcn_axis=dcn_axis, ici_axis=ici_axis)
+    else:
+        kshape = (n_shards, per_shard)
+        shard_k = NamedSharding(mesh, P(axis, None))
+        shard_v = NamedSharding(mesh, P(axis, None, None))
+        repl = NamedSharding(mesh, P(None))
+        step = functools.partial(
+            sharded_sort_payload_step, mesh=mesh, axis=axis)
     args = (
-        jax.device_put(hi_p.reshape(n_shards, per_shard), shard2d),
-        jax.device_put(lo_p.reshape(n_shards, per_shard), shard2d),
-        jax.device_put(rows_p.reshape(n_shards, per_shard), shard2d),
-        jax.device_put(
-            vals_p.reshape(n_shards, per_shard, -1), shard3d
-        ),
+        jax.device_put(hi_p.reshape(kshape), shard_k),
+        jax.device_put(lo_p.reshape(kshape), shard_k),
+        jax.device_put(rows_p.reshape(kshape), shard_k),
+        jax.device_put(vals_p.reshape(kshape + (-1,)), shard_v),
         jax.device_put(s_hi, repl),
         jax.device_put(s_lo, repl),
     )
     for _ in range(3):
-        oh, ol, orows, ovals, counts, ok = sharded_sort_payload_step(
-            *args, mesh=mesh, axis=axis, capacity_factor=capacity_factor
+        oh, ol, orows, ovals, counts, ok = step(
+            *args, capacity_factor=capacity_factor
         )
         if bool(jnp.all(ok)):
-            cnt = np.asarray(counts)
+            cnt = np.asarray(counts).reshape(-1)
+            ovals_h = np.asarray(ovals).reshape(
+                (n_shards, -1) + np.asarray(ovals).shape[-1:])
+            orows_h = np.asarray(orows).reshape(n_shards, -1)
             vh = np.concatenate(
-                [np.asarray(ovals)[i, : cnt[i]] for i in range(n_shards)]
+                [ovals_h[i, : cnt[i]] for i in range(n_shards)]
             )
             perm = np.concatenate(
-                [np.asarray(orows)[i, : cnt[i]] for i in range(n_shards)]
+                [orows_h[i, : cnt[i]] for i in range(n_shards)]
             ).astype(np.int64)
             # every byte of the record arrived through the all_to_all;
             # rebuild offsets from the carried section lengths
@@ -502,60 +519,109 @@ def sharded_coordinate_sort(
 # Hierarchical (DCN, ICI) exchange — the multi-host layering.
 
 
-def _sort_stage_2level(
-    hi, lo, rows, s_hi, s_lo, *, dcn_axis: str, ici_axis: str,
+def _two_stage_exchange(
+    arrs, fills, s_hi, s_lo, *, dcn_axis: str, ici_axis: str,
     n_hosts: int, per_host: int, cap1: int, cap2: int,
 ):
-    """Two-stage exchange body under shard_map over a (dcn, shards)
-    mesh (``runtime/multihost.global_mesh``): stage 1 groups keys by
-    destination HOST and exchanges over the DCN axis (each device talks
-    to its same-ordinal peer on every other host — n_hosts-1 large
-    messages instead of n_devices-1 small ones crossing the network);
-    stage 2 groups by destination device within the host and exchanges
-    over the ICI axis. Device (h, j) ends up holding global range chunk
-    h*per_host + j, so concatenation order matches the flat exchange.
-    """
+    """Two-stage exchange of every array in ``arrs`` (whose first two
+    entries must be the hi/lo key columns; trailing dims ride along):
+    stage 1 groups by destination HOST and exchanges over the DCN axis
+    (each device talks to its same-ordinal peer on every other host —
+    n_hosts-1 large messages instead of n_devices-1 small ones crossing
+    the network); stage 2 groups by destination device within the host
+    and exchanges over the ICI axis. Returns (exchanged arrays, ok)."""
     n_shards = n_hosts * per_host
-    hi, lo, rows = hi.reshape(-1), lo.reshape(-1), rows.reshape(-1)
 
-    # ---- stage 1: to the owning host, over DCN -----------------------
+    def stage(arrs, bucket, nb, cap, axis):
+        sends, counts = _group_scatter(bucket, nb, cap, arrs, fills)
+        ok = (counts <= cap).all()
+        recv = [lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
+                for s in sends]
+        return [r.reshape((-1,) + r.shape[2:]) for r in recv], ok
+
+    hi, lo = arrs[0], arrs[1]
     valid = ~((hi == SENT32) & (lo == SENT32))
     dest = jnp.where(valid, _dest_shard(hi, lo, s_hi, s_lo), n_shards)
-    dest_host = dest // per_host           # phantom -> n_hosts
-    (sh, sl, sr), c1 = _group_scatter(
-        dest_host, n_hosts, cap1, (hi, lo, rows), (SENT32, SENT32, 0))
-    ok1 = (c1 <= cap1).all()
-    rh = lax.all_to_all(sh, dcn_axis, split_axis=0, concat_axis=0)
-    rl = lax.all_to_all(sl, dcn_axis, split_axis=0, concat_axis=0)
-    rr = lax.all_to_all(sr, dcn_axis, split_axis=0, concat_axis=0)
-    hi1, lo1, rows1 = rh.reshape(-1), rl.reshape(-1), rr.reshape(-1)
+    dest_host = dest // per_host            # phantom -> n_hosts
+    arrs1, ok1 = stage(arrs, dest_host, n_hosts, cap1, dcn_axis)
 
-    # ---- stage 2: to the owning device, over ICI ---------------------
+    hi1, lo1 = arrs1[0], arrs1[1]
     valid1 = ~((hi1 == SENT32) & (lo1 == SENT32))
-    dest1 = jnp.where(
-        valid1, _dest_shard(hi1, lo1, s_hi, s_lo), n_shards)
+    dest1 = jnp.where(valid1, _dest_shard(hi1, lo1, s_hi, s_lo), n_shards)
     my_host = lax.axis_index(dcn_axis)
     local = jnp.where(
         valid1, dest1 - my_host * per_host, per_host)  # phantom
-    (sh2, sl2, sr2), c2 = _group_scatter(
-        local, per_host, cap2, (hi1, lo1, rows1), (SENT32, SENT32, 0))
-    ok2 = (c2 <= cap2).all()
-    rh2 = lax.all_to_all(sh2, ici_axis, split_axis=0, concat_axis=0)
-    rl2 = lax.all_to_all(sl2, ici_axis, split_axis=0, concat_axis=0)
-    rr2 = lax.all_to_all(sr2, ici_axis, split_axis=0, concat_axis=0)
-    fh, fl, fr = rh2.reshape(-1), rl2.reshape(-1), rr2.reshape(-1)
-    # rows tie-break: the two-stage arrival order differs from the flat
-    # exchange's, so duplicate keys MUST be ordered by original index
-    # here or multi-host output would diverge from single-host output
+    final_arrs, ok2 = stage(arrs1, local, per_host, cap2, ici_axis)
+    # all-devices ok: reduce over both axes
+    ok = lax.psum(
+        lax.psum((~ok1 | ~ok2).astype(jnp.int32), dcn_axis), ici_axis) == 0
+    return final_arrs, ok
+
+
+def _shard_map():
+    try:
+        from jax import shard_map  # jax >= 0.6 location
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def _hier_geometry(mesh, dcn_axis, ici_axis, per_shard, capacity_factor):
+    """(n_hosts, per_host, cap1, cap2) — the single source of the
+    two-stage capacity formulas for both step wrappers."""
+    n_hosts = mesh.shape[dcn_axis]
+    per_host = mesh.shape[ici_axis]
+    cap1 = min(int(per_shard * capacity_factor / n_hosts) + 1, per_shard)
+    cap2 = min(int(per_shard * capacity_factor / per_host) + 1,
+               n_hosts * cap1)
+    return n_hosts, per_host, cap1, cap2
+
+
+def _finish_two_level(fh, fl, fr, ok, fv=None):
+    """Final local order + validity count for a two-stage exchange.
+    rows tie-break: the two-stage arrival order differs from the flat
+    exchange's, so duplicate keys MUST be ordered by original index
+    here or multi-host output would diverge from single-host output."""
     final = jnp.lexsort((fr, fl, fh))
     out_hi, out_lo, out_rows = fh[final], fl[final], fr[final]
     n_valid = jnp.sum(
         ~((out_hi == SENT32) & (out_lo == SENT32))).astype(jnp.int32)
-    # all-devices ok: reduce over both axes
-    ok = lax.psum(
-        lax.psum((~ok1 | ~ok2).astype(jnp.int32), dcn_axis), ici_axis) == 0
-    return (out_hi[None, None], out_lo[None, None], out_rows[None, None],
-            n_valid[None, None], ok[None, None])
+    head = (out_hi[None, None], out_lo[None, None], out_rows[None, None])
+    if fv is not None:
+        head = head + (fv[final][None, None],)
+    return head + (n_valid[None, None], ok[None, None])
+
+
+def _sort_stage_2level(
+    hi, lo, rows, s_hi, s_lo, *, dcn_axis: str, ici_axis: str,
+    n_hosts: int, per_host: int, cap1: int, cap2: int,
+):
+    """Keys-only two-stage body under shard_map over a (dcn, shards)
+    mesh (``runtime/multihost.global_mesh``). Device (h, j) ends up
+    holding global range chunk h*per_host + j, so concatenation order
+    matches the flat exchange."""
+    (fh, fl, fr), ok = _two_stage_exchange(
+        [hi.reshape(-1), lo.reshape(-1), rows.reshape(-1)],
+        (SENT32, SENT32, 0), s_hi, s_lo,
+        dcn_axis=dcn_axis, ici_axis=ici_axis,
+        n_hosts=n_hosts, per_host=per_host, cap1=cap1, cap2=cap2)
+    return _finish_two_level(fh, fl, fr, ok)
+
+
+def _sort_stage_2level_payload(
+    hi, lo, rows, vals, s_hi, s_lo, *, dcn_axis: str, ici_axis: str,
+    n_hosts: int, per_host: int, cap1: int, cap2: int,
+):
+    """As ``_sort_stage_2level`` but the WHOLE record (fixed columns +
+    padded ragged bytes) rides both stages of the exchange."""
+    m = hi.reshape(-1).shape[0]
+    (fh, fl, fr, fv), ok = _two_stage_exchange(
+        [hi.reshape(-1), lo.reshape(-1), rows.reshape(-1),
+         vals.reshape(m, -1)],
+        (SENT32, SENT32, 0, 0), s_hi, s_lo,
+        dcn_axis=dcn_axis, ici_axis=ici_axis,
+        n_hosts=n_hosts, per_host=per_host, cap1=cap1, cap2=cap2)
+    return _finish_two_level(fh, fl, fr, ok, fv)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -572,21 +638,12 @@ def hierarchical_sort_step(
     (hi, lo, rows, valid_counts, ok) with the same global-order
     concatenation contract as the flat exchange.
     """
-    n_hosts = mesh.shape[dcn_axis]
-    per_host = mesh.shape[ici_axis]
-    per_shard = hi.shape[2]
-    cap1 = min(int(per_shard * capacity_factor / n_hosts) + 1, per_shard)
-    cap2 = min(int(per_shard * capacity_factor / per_host) + 1,
-               n_hosts * cap1)
+    n_hosts, per_host, cap1, cap2 = _hier_geometry(
+        mesh, dcn_axis, ici_axis, hi.shape[2], capacity_factor)
     body = functools.partial(
         _sort_stage_2level, dcn_axis=dcn_axis, ici_axis=ici_axis,
         n_hosts=n_hosts, per_host=per_host, cap1=cap1, cap2=cap2)
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-
-    return shard_map(
+    return _shard_map()(
         body,
         mesh=mesh,
         in_specs=(
@@ -599,6 +656,38 @@ def hierarchical_sort_step(
             P(dcn_axis, ici_axis),
         ),
     )(hi, lo, rows, s_hi, s_lo)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "dcn_axis", "ici_axis", "capacity_factor"))
+def hierarchical_sort_payload_step(
+    hi, lo, rows, vals, s_hi, s_lo, *, mesh: Mesh,
+    dcn_axis: str = "dcn", ici_axis: str = "shards",
+    capacity_factor: float = 2.0,
+):
+    """Two-stage exchange moving keys AND the (n_hosts, per_host,
+    per_shard, W) u32 record payload — whole records cross DCN once in
+    host-sized messages, then fan out over ICI."""
+    n_hosts, per_host, cap1, cap2 = _hier_geometry(
+        mesh, dcn_axis, ici_axis, hi.shape[2], capacity_factor)
+    body = functools.partial(
+        _sort_stage_2level_payload, dcn_axis=dcn_axis, ici_axis=ici_axis,
+        n_hosts=n_hosts, per_host=per_host, cap1=cap1, cap2=cap2)
+    return _shard_map()(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(dcn_axis, ici_axis, None), P(dcn_axis, ici_axis, None),
+            P(dcn_axis, ici_axis, None),
+            P(dcn_axis, ici_axis, None, None), P(None), P(None),
+        ),
+        out_specs=(
+            P(dcn_axis, ici_axis, None), P(dcn_axis, ici_axis, None),
+            P(dcn_axis, ici_axis, None),
+            P(dcn_axis, ici_axis, None, None),
+            P(dcn_axis, ici_axis), P(dcn_axis, ici_axis),
+        ),
+    )(hi, lo, rows, vals, s_hi, s_lo)
 
 
 def hierarchical_coordinate_sort(

@@ -144,3 +144,32 @@ class TestHierarchicalSort:
         # and both equal the stable host argsort
         np.testing.assert_array_equal(
             flat_perm, np.argsort(keys, kind="stable"))
+
+    def test_whole_records_through_hierarchical_exchange(self, tmp_path):
+        # sharded_sort_read_batch over a (dcn, shards) mesh: the WHOLE
+        # record rides the two-stage exchange; result must be
+        # byte-identical to the flat-mesh path
+        import numpy as np
+        from disq_tpu.sort.sharded import make_mesh, sharded_sort_read_batch
+        from tests.bam_oracle import (
+            DEFAULT_REFS,
+            make_bam_bytes,
+            synth_records,
+        )
+        from disq_tpu.api import ReadsStorage
+
+        recs = synth_records(4000, seed=23, sorted_coord=False)
+        p = tmp_path / "in.bam"
+        p.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+        batch = ReadsStorage.make_default().read(str(p)).reads
+
+        flat_b, flat_perm = sharded_sort_read_batch(batch, make_mesh())
+        hier_b, hier_perm = sharded_sort_read_batch(
+            batch, self._mesh(2, 4))
+        np.testing.assert_array_equal(flat_perm, hier_perm)
+        for f in ("refid", "pos", "mapq", "bin", "flag", "next_refid",
+                  "next_pos", "tlen", "name_offsets", "names",
+                  "cigar_offsets", "cigars", "seq_offsets", "seqs",
+                  "quals", "tag_offsets", "tags"):
+            np.testing.assert_array_equal(
+                getattr(flat_b, f), getattr(hier_b, f), err_msg=f)
