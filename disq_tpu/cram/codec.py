@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from disq_tpu.bam.columnar import ReadBatch, SEQ_NT16
-from disq_tpu.cram.io import Cursor, write_itf8
+from disq_tpu.cram.io import Cursor, write_itf8, write_itf8_array
 from disq_tpu.index.bai import bins_from_cigars
 
 # Encoding codec ids (CRAM 3.0 §12)
@@ -598,12 +598,13 @@ def encode_container(
         cf_codes = canonical_assign(cf_syms, cf_lens)
     tag_line_index: Dict[tuple, int] = {}
     tag_lines: List[List[int]] = []
+    tl_vals: List[int] = []
+    fn_vals: List[int] = []
     total_bases = 0
     any_ref_omitted = False
 
     ends = batch.alignment_ends()
     for i in range(n):
-        flag = int(batch.flag[i])
         l_seq = int(batch.seq_offsets[i + 1] - batch.seq_offsets[i])
         cig_s, cig_e = batch.cigar_offsets[i], batch.cigar_offsets[i + 1]
         cigar = batch.cigars[cig_s:cig_e]
@@ -613,22 +614,13 @@ def encode_container(
                 "sequence bases is not representable via read features"
             )
         cf = int(cf_vals[i])
-        streams.put_itf8(CID["BF"], flag)
+        # fixed one-value-per-record series (BF/CF/RL/AP/RG/RN/MF/NS/
+        # NP/TS/MQ/QS) are bulk-encoded after the loop — per-cid stream
+        # order is record order either way, and the vectorized ITF8
+        # array encoder replaces ~12 put_itf8 calls per record
         if cf_codes is not None:
             code, nb = cf_codes[cf]
             bw.write(code, nb)
-        else:
-            streams.put_itf8(CID["CF"], cf)
-        streams.put_itf8(CID["RL"], l_seq)
-        streams.put_itf8(CID["AP"], int(batch.pos[i]) + 1)
-        streams.put_itf8(CID["RG"], -1)
-        name = batch.names[batch.name_offsets[i]:batch.name_offsets[i + 1]]
-        streams.put_bytes(CID["RN"], name.tobytes() + b"\x00")
-        mf = (1 if flag & 0x20 else 0) | (2 if flag & 0x8 else 0)
-        streams.put_itf8(CID["MF"], mf)
-        streams.put_itf8(CID["NS"], int(batch.next_refid[i]))
-        streams.put_itf8(CID["NP"], int(batch.next_pos[i]) + 1)
-        streams.put_itf8(CID["TS"], int(batch.tlen[i]))
         # tags
         entries = split_tags(
             batch.tags[batch.tag_offsets[i]:batch.tag_offsets[i + 1]].tobytes()
@@ -638,7 +630,7 @@ def encode_container(
         if tl is None:
             tl = tag_line_index[line] = len(tag_lines)
             tag_lines.append(list(line))
-        streams.put_itf8(CID["TL"], tl)
+        tl_vals.append(tl)
         for key, val in entries:
             cid = TAG_CID_BASE + key
             streams.put_itf8(cid, len(val))
@@ -698,7 +690,7 @@ def encode_container(
         if core_profile:
             _gamma_write(bw, len(features), 1)   # GAMMA(offset=1)
         else:
-            streams.put_itf8(CID["FN"], len(features))
+            fn_vals.append(len(features))
         prev = 0
         for fpos, code, payload in features:
             streams.put_bytes(CID["FC"], code.encode())
@@ -722,10 +714,40 @@ def encode_container(
         # these series shares the CORE bit stream
         if core_profile:
             bw.write(int(batch.mapq[i]), 8)      # BETA(0, 8)
-        else:
-            streams.put_itf8(CID["MQ"], int(batch.mapq[i]))
-        q = batch.quals[batch.seq_offsets[i]:batch.seq_offsets[i + 1]]
-        streams.put_bytes(CID["QS"], q.tobytes())
+
+    if n:
+        # bulk-encoded fixed series (see the loop comment): one
+        # vectorized ITF8 pass per series instead of per-record varints
+        flags64 = batch.flag.astype(np.int64)
+        streams.put_bytes(CID["BF"], write_itf8_array(flags64))
+        if cf_codes is None:
+            streams.put_bytes(CID["CF"], write_itf8_array(cf_vals))
+        streams.put_bytes(CID["RL"], write_itf8_array(seq_lens))
+        streams.put_bytes(
+            CID["AP"], write_itf8_array(batch.pos.astype(np.int64) + 1))
+        streams.put_bytes(CID["RG"], write_itf8(-1) * n)  # constant series
+        # RN: a NUL terminator after every name, in one insert
+        rn = np.insert(
+            batch.names,
+            np.asarray(batch.name_offsets[1:], dtype=np.int64), 0)
+        streams.put_bytes(CID["RN"], rn.tobytes())
+        mf_vals = ((flags64 >> 5) & 1) | (((flags64 >> 3) & 1) << 1)
+        streams.put_bytes(CID["MF"], write_itf8_array(mf_vals))
+        streams.put_bytes(
+            CID["NS"], write_itf8_array(batch.next_refid.astype(np.int64)))
+        streams.put_bytes(
+            CID["NP"],
+            write_itf8_array(batch.next_pos.astype(np.int64) + 1))
+        streams.put_bytes(
+            CID["TS"], write_itf8_array(batch.tlen.astype(np.int64)))
+        streams.put_bytes(CID["TL"], write_itf8_array(tl_vals))
+        if not core_profile:
+            streams.put_bytes(CID["FN"], write_itf8_array(fn_vals))
+            streams.put_bytes(
+                CID["MQ"], write_itf8_array(batch.mapq.astype(np.int64)))
+        # QS: quals are contiguous in record order already
+        streams.put_bytes(CID["QS"], np.ascontiguousarray(
+            batch.quals).tobytes())
 
     comp_header = CompressionHeader(
         rn_preserved=True, ap_delta=False,

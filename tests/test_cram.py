@@ -429,3 +429,17 @@ class TestColumnarFastPath:
                   "quals", "tag_offsets", "tags"):
             np.testing.assert_array_equal(
                 getattr(fast, f), getattr(slow, f), err_msg=f)
+
+
+class TestItf8ArrayEncoder:
+    def test_byte_identical_to_scalar_encoder(self):
+        from disq_tpu.cram.io import write_itf8, write_itf8_array
+
+        rng = np.random.default_rng(11)
+        vals = ([0, 1, 127, 128, 16383, 16384, 2097151, 2097152,
+                 268435455, 268435456, (1 << 31) - 1, -1, -100,
+                 -(1 << 31)]
+                + rng.integers(-(1 << 31), 1 << 31, 5000).tolist())
+        assert write_itf8_array(vals) == b"".join(
+            write_itf8(v) for v in vals)
+        assert write_itf8_array([]) == b""
