@@ -22,6 +22,7 @@ import numpy as np
 
 SEQ_NT16 = "=ACMGRSVTWYHKDBN"
 CIGAR_OPS = "MIDNSHP=X"
+_NT16_CHARS = np.frombuffer(SEQ_NT16.encode(), dtype=np.uint8)
 
 
 def segment_gather(
@@ -186,7 +187,9 @@ class ReadBatch:
 
     def sequence(self, i: int) -> str:
         s, e = self.seq_offsets[i], self.seq_offsets[i + 1]
-        return "".join(SEQ_NT16[c] for c in self.seqs[s:e])
+        # vectorized nibble->char table lookup (a per-char genexpr here
+        # was the hottest line of SAM text write)
+        return _NT16_CHARS[self.seqs[s:e]].tobytes().decode("ascii")
 
     def cigar_string(self, i: int) -> str:
         s, e = self.cigar_offsets[i], self.cigar_offsets[i + 1]
@@ -200,7 +203,7 @@ class ReadBatch:
         q = self.quals[s:e]
         if len(q) == 0 or (len(q) > 0 and q[0] == 0xFF):
             return "*"
-        return "".join(chr(int(x) + 33) for x in q)
+        return (q + 33).astype(np.uint8).tobytes().decode("latin-1")
 
     # Reference-consumed length on the genome, per record (vectorized):
     # ops M/D/N/=/X (0,2,3,7,8) consume reference. Used by BAI binning

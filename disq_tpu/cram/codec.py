@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from disq_tpu.bam.columnar import ReadBatch, SEQ_NT16
+from disq_tpu.bam.columnar import _NT16_CHARS, ReadBatch, SEQ_NT16
 from disq_tpu.cram.io import Cursor, write_itf8, write_itf8_array
 from disq_tpu.index.bai import bins_from_cigars
 
@@ -63,7 +63,6 @@ SERIES = [
 CID = {name: i + 1 for i, name in enumerate(SERIES)}
 TAG_CID_BASE = 0x10000  # tag series ids live above the fixed series
 
-_NT16_BYTES = np.frombuffer(SEQ_NT16.encode(), dtype=np.uint8)
 _CHAR_TO_NT16 = np.zeros(256, dtype=np.uint8)
 for _i, _c in enumerate(SEQ_NT16):
     _CHAR_TO_NT16[ord(_c)] = _i
@@ -541,7 +540,7 @@ class _Readers:
 
 def _seq_chars(batch: ReadBatch, i: int) -> np.ndarray:
     s, e = batch.seq_offsets[i], batch.seq_offsets[i + 1]
-    return _NT16_BYTES[batch.seqs[s:e]]
+    return _NT16_CHARS[batch.seqs[s:e]]
 
 
 def _qs_order1() -> bool:
