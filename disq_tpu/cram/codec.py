@@ -922,6 +922,56 @@ def _bulk_split_names(rd, comp, enc, n) -> Optional[List[bytes]]:
     return segs
 
 
+def _bulk_feature_streams(rd, comp, enc, cols):
+    """Pre-slice the FC byte stream and pre-decode the FP delta stream
+    for all features of the slice (counts known from the bulk FN
+    column), when both are EXTERNAL over exclusive blocks. Returns
+    (fc_bytes, fp_deltas) or None → per-feature reads."""
+    fce, fpe = enc.get("FC"), enc.get("FP")
+    if (fce is None or fpe is None
+            or fce.codec != E_EXTERNAL or fpe.codec != E_EXTERNAL
+            or fce.params == fpe.params):
+        return None
+    used = _external_cids_excluding(comp, enc, ("FC", "FP"))
+    if fce.params in used or fpe.params in used:
+        return None
+    cfc, cfp = rd.cur.get(fce.params), rd.cur.get(fpe.params)
+    if cfc is None or cfp is None:
+        return None
+    total = int(sum(cols["FN"]))
+    if len(cfc.data) - cfc.off < total:
+        return None
+    saved = cfp.off
+    try:
+        fp_all = cfp.itf8_bulk(total)
+    except IndexError:
+        cfp.off = saved
+        return None
+    fc_all = bytes(cfc.data[cfc.off: cfc.off + total])
+    cfc.off += total
+    return fc_all, fp_all
+
+
+def _bulk_quals(rd, comp, enc, cols):
+    """The slice's whole QS byte stream in one read when every record
+    stores qualities and QS is EXTERNAL over an exclusive block.
+    Returns the bytes or None → per-record reads."""
+    qse = enc.get("QS")
+    if qse is None or qse.codec != E_EXTERNAL:
+        return None
+    if any((cf & CF_QS_STORED) == 0 for cf in cols["CF"]):
+        return None
+    if qse.params in _external_cids_excluding(comp, enc, ("QS",)):
+        return None
+    c = rd.cur.get(qse.params)
+    total_bases = int(sum(cols["RL"]))
+    if c is None or len(c.data) - c.off < total_bases:
+        return None
+    blob = bytes(c.data[c.off: c.off + total_bases])
+    c.off += total_bases
+    return blob
+
+
 def _decode_slice(
     slice_hdr, comp: CompressionHeader, blocks: Dict[int, bytes], core,
     ref_fetch,
@@ -967,6 +1017,12 @@ def _decode_slice(
         cols["AP"] = ap_cum.tolist()
     rn_names = _bulk_split_names(rd, comp, enc, n) if cols is not None \
         else None
+    fstreams = _bulk_feature_streams(rd, comp, enc, cols) \
+        if cols is not None else None
+    qs_blob = _bulk_quals(rd, comp, enc, cols) \
+        if cols is not None else None
+    fidx = 0
+    qoff = 0
 
     for i in range(n):
         if cols is not None:
@@ -1011,8 +1067,13 @@ def _decode_slice(
         features = []
         fpos = 0
         for _ in range(fn):
-            code = chr(rd.read_byte(enc["FC"]))
-            fpos += rd.read_int(enc["FP"])
+            if fstreams is not None:
+                code = chr(fstreams[0][fidx])
+                fpos += fstreams[1][fidx]
+                fidx += 1
+            else:
+                code = chr(rd.read_byte(enc["FC"]))
+                fpos += rd.read_int(enc["FP"])
             if code == "b":
                 payload = rd.read_array(enc["BB"])
             elif code == "I":
@@ -1031,7 +1092,12 @@ def _decode_slice(
                 raise ValueError(f"unsupported read feature {code!r}")
             features.append((fpos, code, payload))
         mq = cols["MQ"][i] if cols is not None else rd.read_int(enc["MQ"])
-        quals = rd.read_bytes_len(enc["QS"], rl) if cf & CF_QS_STORED else b"\xff" * rl
+        if qs_blob is not None:
+            quals = qs_blob[qoff: qoff + rl]
+            qoff += rl
+        else:
+            quals = (rd.read_bytes_len(enc["QS"], rl)
+                     if cf & CF_QS_STORED else b"\xff" * rl)
 
         # reconstruct seq + cigar
         pos0 = ap - 1
