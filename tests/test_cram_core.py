@@ -408,3 +408,114 @@ class TestForeignSliceShapes:
                 decode_container_records(blob)
         finally:
             codec.CompressionHeader.parse = orig
+
+
+class TestSharedBlockLayouts:
+    """Foreign CRAMs may route several data series through ONE external
+    block (values interleaved in record order). The bulk fast paths
+    must decline such layouts and the per-record loop must decode them
+    correctly."""
+
+    def _shared_slice(self):
+        from disq_tpu.cram.codec import (
+            CID,
+            CompressionHeader,
+            E_EXTERNAL,
+            Encoding,
+            _decode_slice,
+        )
+        from disq_tpu.cram.io import write_itf8
+        from disq_tpu.cram.structure import SliceHeader
+
+        ext = lambda cid: Encoding(E_EXTERNAL, cid)  # noqa: E731
+        SHARED = 99
+        comp = CompressionHeader(
+            rn_preserved=False, ap_delta=False, ref_required=False,
+            tag_lines=[[]],
+            series_enc={
+                # BF and CF share one block — interleaved per record
+                "BF": ext(SHARED), "CF": ext(SHARED),
+                "RL": ext(CID["RL"]), "AP": ext(CID["AP"]),
+                "RG": ext(CID["RG"]), "MF": ext(CID["MF"]),
+                "NS": ext(CID["NS"]), "NP": ext(CID["NP"]),
+                "TS": ext(CID["TS"]), "TL": ext(CID["TL"]),
+                "FN": ext(CID["FN"]), "MQ": ext(CID["MQ"]),
+                "QS": ext(CID["QS"]),
+            },
+        )
+        n = 3
+        flags = [0, 16, 4]
+        cf = 0x1 | 0x2 | 0x8   # QS stored, detached, unknown bases
+        rl = [4, 5, 3]
+        blocks = {
+            SHARED: b"".join(
+                write_itf8(f) + write_itf8(cf) for f in flags),
+            CID["RL"]: b"".join(write_itf8(v) for v in rl),
+            CID["AP"]: b"".join(write_itf8(v) for v in (11, 21, 0)),
+            CID["RG"]: write_itf8(-1) * n,
+            CID["MF"]: write_itf8(0) * n,
+            CID["NS"]: b"".join(write_itf8(v) for v in (-1, -1, -1)),
+            CID["NP"]: write_itf8(0) * n,
+            CID["TS"]: write_itf8(0) * n,
+            CID["TL"]: write_itf8(0) * n,
+            CID["FN"]: write_itf8(0) * n,
+            CID["MQ"]: b"".join(write_itf8(v) for v in (9, 8, 0)),
+            CID["QS"]: bytes(range(sum(rl))),
+        }
+        hdr = SliceHeader(
+            ref_seq_id=0, ref_start=11, ref_span=20, n_records=n,
+            record_counter=0, n_blocks=len(blocks),
+            content_ids=sorted(blocks),
+        )
+        return _decode_slice, hdr, comp, blocks, flags, rl
+
+    def test_interleaved_shared_block_decodes_via_loop(self, monkeypatch):
+        import disq_tpu.cram.codec as codec_mod
+
+        decode_slice, hdr, comp, blocks, flags, rl = self._shared_slice()
+        outcome = {}
+        real = codec_mod._bulk_fixed_series
+
+        def spy(*a, **k):
+            r = real(*a, **k)
+            outcome["bulk"] = r is not None
+            return r
+
+        monkeypatch.setattr(codec_mod, "_bulk_fixed_series", spy)
+        batch = decode_slice(hdr, comp, blocks, b"", None)
+        assert outcome == {"bulk": False}  # shared cid -> declined
+        assert batch.count == 3
+        np.testing.assert_array_equal(batch.flag, flags)
+        np.testing.assert_array_equal(np.diff(batch.seq_offsets), rl)
+        np.testing.assert_array_equal(batch.pos, [10, 20, -1])
+        # QS bytes arrive intact through the per-record path
+        np.testing.assert_array_equal(
+            batch.quals, np.arange(sum(rl), dtype=np.uint8))
+
+    def test_eligibility_gates_directly(self):
+        from disq_tpu.cram.codec import (
+            CID,
+            E_BYTE_ARRAY_STOP,
+            E_EXTERNAL,
+            Encoding,
+            _bulk_split_names,
+            _external_cids_excluding,
+        )
+
+        ext = lambda cid: Encoding(E_EXTERNAL, cid)  # noqa: E731
+
+        class _Comp:
+            tag_enc = {0x4E4D43: ext(77)}
+
+        enc = {"RN": Encoding(E_BYTE_ARRAY_STOP, (0, 77)), "BF": ext(1)}
+        used = _external_cids_excluding(_Comp, enc, ("RN",))
+        assert 77 in used and 1 in used  # tag shares RN's block
+
+        class _Rd:
+            cur = {}
+
+        class _Comp2:
+            tag_enc = {}
+            rn_preserved = True
+
+        assert _bulk_split_names(_Rd, _Comp2, enc, 5) is None
