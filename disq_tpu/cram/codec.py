@@ -952,6 +952,93 @@ def _bulk_feature_streams(rd, comp, enc, cols):
     return fc_all, fp_all
 
 
+def _bulk_bb(rd, comp, enc, fstreams):
+    """All 'b'-feature payloads of the slice (count known from the bulk
+    FC stream) when BB is BYTE_ARRAY_LEN over two distinct exclusive
+    EXTERNAL blocks — our writer's and the usual layout. Returns the
+    payload list or None → per-feature reads."""
+    if fstreams is None:
+        return None
+    bbe = enc.get("BB")
+    if bbe is None or bbe.codec != E_BYTE_ARRAY_LEN:
+        return None
+    len_e, val_e = bbe.params
+    if (len_e.codec != E_EXTERNAL or val_e.codec != E_EXTERNAL
+            or len_e.params == val_e.params):
+        return None
+    used = _external_cids_excluding(comp, enc, ("BB",))
+    if len_e.params in used or val_e.params in used:
+        return None
+    cl, cv = rd.cur.get(len_e.params), rd.cur.get(val_e.params)
+    if cl is None or cv is None:
+        return None
+    count_b = fstreams[0].count(ord("b"))
+    saved = cl.off
+    try:
+        lens = cl.itf8_bulk(count_b)
+    except IndexError:
+        cl.off = saved
+        return None
+    total = sum(lens)
+    if any(ln < 0 for ln in lens) or len(cv.data) - cv.off < total:
+        cl.off = saved
+        return None
+    data = cv.data
+    off = cv.off
+    out = []
+    for ln in lens:
+        out.append(bytes(data[off: off + ln]))
+        off += ln
+    cv.off = off
+    return out
+
+
+def _bulk_tags(rd, comp, enc, cols):
+    """Per-tag-key value iterators for keys whose value series is the
+    interleaved (length, bytes) layout over one exclusive EXTERNAL
+    block — our writer's layout. Keys with any other layout simply stay
+    on per-record reads."""
+    from collections import Counter
+
+    keys = {k for line in comp.tag_lines for k in line}
+    if not keys:
+        return {}
+    counts: Dict[int, int] = {k: 0 for k in keys}
+    lines = comp.tag_lines
+    for tl, c_tl in Counter(cols["TL"]).items():
+        for k in lines[tl]:
+            counts[k] += c_tl
+    # one cid-occurrence count across every encoding: a same-cid
+    # BYTE_ARRAY_LEN tag contributes exactly its own 2 refs (len+val),
+    # so any count above 2 means the block is shared with something
+    cid_refs = Counter()
+    for e2 in enc.values():
+        cid_refs.update(_enc_cids(e2))
+    for e2 in comp.tag_enc.values():
+        cid_refs.update(_enc_cids(e2))
+    out: Dict[int, object] = {}
+    for k in keys:
+        e = comp.tag_enc.get(k)
+        if e is None or e.codec != E_BYTE_ARRAY_LEN:
+            continue
+        len_e, val_e = e.params
+        if (len_e.codec != E_EXTERNAL or val_e.codec != E_EXTERNAL
+                or len_e.params != val_e.params):
+            continue
+        cid = len_e.params
+        if cid_refs[cid] != 2:
+            continue
+        c = rd.cur.get(cid)
+        if c is None:
+            continue
+        try:
+            # len_prefixed_bulk commits the cursor only on full success
+            out[k] = iter(c.len_prefixed_bulk(counts[k]))
+        except IndexError:
+            pass
+    return out
+
+
 def _bulk_quals(rd, comp, enc, cols):
     """The slice's whole QS byte stream in one read when every record
     stores qualities and QS is EXTERNAL over an exclusive block.
@@ -1021,7 +1108,10 @@ def _decode_slice(
         if cols is not None else None
     qs_blob = _bulk_quals(rd, comp, enc, cols) \
         if cols is not None else None
+    bb_vals = _bulk_bb(rd, comp, enc, fstreams)
+    tag_bulk = _bulk_tags(rd, comp, enc, cols) if cols is not None else {}
     fidx = 0
+    bidx = 0
     qoff = 0
 
     for i in range(n):
@@ -1060,7 +1150,9 @@ def _decode_slice(
             tl = rd.read_int(enc["TL"])
         tag_entries = []
         for key in comp.tag_lines[tl]:
-            val = rd.read_array(comp.tag_enc[key])
+            it = tag_bulk.get(key)
+            val = next(it) if it is not None \
+                else rd.read_array(comp.tag_enc[key])
             tag_entries.append((key, val))
         # features (MQ follows them — CRAM 3.0 record layout)
         fn = cols["FN"][i] if cols is not None else rd.read_int(enc["FN"])
@@ -1075,7 +1167,11 @@ def _decode_slice(
                 code = chr(rd.read_byte(enc["FC"]))
                 fpos += rd.read_int(enc["FP"])
             if code == "b":
-                payload = rd.read_array(enc["BB"])
+                if bb_vals is not None:
+                    payload = bb_vals[bidx]
+                    bidx += 1
+                else:
+                    payload = rd.read_array(enc["BB"])
             elif code == "I":
                 payload = rd.read_array(enc["IN"])
             elif code == "S":

@@ -228,6 +228,36 @@ class Cursor:
         self.off = off
         return out
 
+    def len_prefixed_bulk(self, count: int) -> List[bytes]:
+        """``count`` (ITF8 length, payload bytes) items from an
+        interleaved stream (the layout CRAM BYTE_ARRAY_LEN uses when
+        length and value share one block — e.g. tag value series).
+        Raises IndexError past the stream end."""
+        if count <= 0:
+            return []
+        if self._v is None:
+            self._build_itf8_table()
+        vl, nbl = self._v, self._nb
+        ln_total = len(vl)
+        data = self.data
+        off = self.off
+        out = []
+        ap = out.append
+        for _ in range(count):
+            if off >= ln_total:
+                raise IndexError("read past end of stream")
+            w = int(nbl[off])
+            if off + w > ln_total:
+                raise IndexError("truncated ITF8 at end of stream")
+            ln = int(vl[off])
+            off += w
+            if ln < 0 or off + ln > ln_total:
+                raise IndexError("length-prefixed item overruns stream")
+            ap(bytes(data[off: off + ln]))
+            off += ln
+        self.off = off
+        return out
+
     def ltf8(self) -> int:
         v, self.off = read_ltf8(self.data, self.off)
         return v
