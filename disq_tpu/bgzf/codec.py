@@ -58,7 +58,12 @@ def inflate_block(data: bytes, offset: int = 0, verify_crc: bool = True) -> byte
     hdr_len = 12 + xlen
     payload = data[offset + hdr_len: offset + total - BGZF_FOOTER_SIZE]
     crc, isize = struct.unpack_from("<II", data, offset + total - BGZF_FOOTER_SIZE)
-    out = zlib.decompress(payload, wbits=-15, bufsize=isize or 1)
+    try:
+        out = zlib.decompress(payload, wbits=-15, bufsize=isize or 1)
+    except zlib.error as e:
+        # corrupt deflate bits fail BEFORE the CRC check — keep the
+        # framework's ValueError contract for corrupt inputs
+        raise ValueError(f"corrupt DEFLATE stream in BGZF block: {e}") from e
     if len(out) != isize:
         raise ValueError(f"BGZF ISIZE mismatch: {len(out)} != {isize}")
     if verify_crc and zlib.crc32(out) != crc:

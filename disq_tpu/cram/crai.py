@@ -38,7 +38,10 @@ class CraiIndex:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "CraiIndex":
-        text = gzip.decompress(data).decode()
+        try:
+            text = gzip.decompress(data).decode()
+        except Exception as e:   # gzip/zlib/unicode errors
+            raise ValueError(f"corrupt .crai index: {e}") from e
         entries = []
         for line in text.splitlines():
             if not line.strip():

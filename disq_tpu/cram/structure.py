@@ -97,22 +97,28 @@ class Block:
         (crc,) = struct.unpack("<I", cur.bytes(4))
         if zlib.crc32(body) != crc:
             raise ValueError("CRAM block CRC mismatch")
-        if method == RAW:
-            data = comp
-        elif method == GZIP:
-            data = _gzip.decompress(comp)
-        elif method == RANS:
-            data = rans_decode(comp)
-        elif method == BZIP2:
-            import bz2
+        try:
+            if method == RAW:
+                data = comp
+            elif method == GZIP:
+                data = _gzip.decompress(comp)
+            elif method == RANS:
+                data = rans_decode(comp)
+            elif method == BZIP2:
+                import bz2
 
-            data = bz2.decompress(comp)
-        elif method == LZMA:
-            import lzma
+                data = bz2.decompress(comp)
+            elif method == LZMA:
+                import lzma
 
-            data = lzma.decompress(comp)
-        else:
-            raise ValueError(f"unsupported CRAM block method {method}")
+                data = lzma.decompress(comp)
+            else:
+                raise ValueError(f"unsupported CRAM block method {method}")
+        except ValueError:
+            raise
+        except Exception as e:   # zlib.error / OSError / LZMAError ...
+            raise ValueError(
+                f"corrupt CRAM block body (method {method}): {e}") from e
         if len(data) != raw_size:
             raise ValueError("CRAM block raw size mismatch")
         return cls(content_type, content_id, data, method)

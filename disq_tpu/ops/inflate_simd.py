@@ -862,7 +862,8 @@ def inflate_payloads_simd(
 
     Returns the decompressed bytes per payload. Lanes that fail in-kernel
     (nonzero status) are re-inflated with host zlib — corruption is the
-    host's problem to report, with the same exceptions as the host path.
+    host's problem to adjudicate, surfaced as ``ValueError`` (the
+    framework's corrupt-input contract).
     """
     import zlib
 
@@ -879,6 +880,12 @@ def inflate_payloads_simd(
     if big:
         import zlib as _z
 
+        def _host(p):
+            try:
+                return _z.decompress(p, wbits=-15)
+            except _z.error as e:
+                raise ValueError(f"corrupt DEFLATE stream: {e}") from e
+
         bigset = set(big)
         small = [p for i, p in enumerate(payloads) if i not in bigset]
         small_us = (None if usizes is None else
@@ -886,7 +893,7 @@ def inflate_payloads_simd(
         small_out = iter(
             inflate_payloads_simd(small, small_us, interpret=interpret))
         return [
-            _z.decompress(p, wbits=-15) if i in bigset else next(small_out)
+            _host(p) if i in bigset else next(small_out)
             for i, p in enumerate(payloads)
         ]
     max_c = max(len(p) for p in payloads)
@@ -927,7 +934,11 @@ def inflate_payloads_simd(
             n, status = int(meta[0, i]), int(meta[1, i])
             expect = None if usizes is None else int(usizes[lo + i])
             if status != 0 or (expect is not None and n != expect):
-                host = zlib.decompress(p, wbits=-15)
+                try:
+                    host = zlib.decompress(p, wbits=-15)
+                except zlib.error as e:
+                    raise ValueError(
+                        f"corrupt DEFLATE stream: {e}") from e
                 if expect is not None and len(host) != expect:
                     # genuine ISIZE mismatch (error 8) — the host path
                     # raises here too; swallowing it would break the
