@@ -132,3 +132,43 @@ class TestSimdInflateFuzz:
             # else: zlib raises only on *truncated* tail state that the
             # kernel's bounded decode legitimately completes; the codec
             # layer's CRC check is the arbiter there — nothing to assert
+
+
+class TestCorruptInputContract:
+    """Random multi-byte corruption of whole container files must
+    surface as ValueError — never a raw codec exception (zlib.error,
+    struct.error, ...) and never a crash. The full soak (600+ trials)
+    runs out-of-suite; this bounded version pins the contract."""
+
+    def test_bam_and_cram_corruptions_raise_valueerror(self, tmp_path):
+        from disq_tpu.api import ReadsFormatWriteOption, ReadsStorage
+        from tests.bam_oracle import (
+            DEFAULT_REFS,
+            make_bam_bytes,
+            synth_records,
+        )
+
+        recs = synth_records(800, seed=71, sorted_coord=True)
+        bam = make_bam_bytes(DEFAULT_REFS, recs)
+        st = ReadsStorage.make_default()
+        (tmp_path / "in.bam").write_bytes(bam)
+        ds = st.read(str(tmp_path / "in.bam"))
+        st.write(ds, str(tmp_path / "o.cram"), ReadsFormatWriteOption.CRAM)
+        blobs = {".bam": bam,
+                 ".cram": (tmp_path / "o.cram").read_bytes()}
+        rng = np.random.default_rng(5)
+        seen_error = 0
+        for trial in range(40):
+            ext = ".bam" if trial % 2 else ".cram"
+            src = bytearray(blobs[ext])
+            for _ in range(int(rng.integers(1, 6))):
+                p = int(rng.integers(0, len(src)))
+                src[p] ^= int(rng.integers(1, 256))
+            mut = tmp_path / f"m{trial}{ext}"
+            mut.write_bytes(bytes(src))
+            try:
+                st.read(str(mut)).count()
+            except ValueError:
+                seen_error += 1
+            # any other exception type propagates and fails the test
+        assert seen_error > 20  # corruption overwhelmingly detected
