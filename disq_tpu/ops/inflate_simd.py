@@ -316,14 +316,14 @@ def _inflate_simd_kernel(
     cntd_ref, firstd_ref, offd_ref, cursd_ref,
     cntc_ref, firstc_ref, offc_ref, cursc_ref,
     ring_ref,
-    *, cw: int, ow: int, max_steps: int,
+    *, cw: int, ow: int, max_steps: int, slab: int,
 ):
     zrow = jnp.zeros((1, LANES), _I32)
     zrow_u = jnp.zeros((1, LANES), _U32)
     # slab-wise init + RMW below keep peak scoped-vmem temps ~1 MB so
     # comp (8192,128) fits alongside out (16384,128)
-    for _s in range(0, ow, _SLAB):
-        _sl = min(_SLAB, ow - _s)
+    for _s in range(0, ow, slab):
+        _sl = min(slab, ow - _s)
         out_ref[_s:_s + _sl, :] = jnp.zeros((_sl, LANES), _U32)
     for ref in (symlit_ref, symdist_ref, symcl_ref, lens_ref, cl_lens_ref):
         ref[...] = jnp.zeros(ref.shape, ref.dtype)
@@ -338,13 +338,15 @@ def _inflate_simd_kernel(
     # One *word-aligned* single gather per refill site (the one-hot fast
     # path); two refill sites per superstep keep every phase's peek
     # within the low word: pre-phase-A cnt >= 33, phase A consumes <= 32
-    # (a word-aligned 4-byte stored copy; Huffman paths <= 20),
+    # (a word-aligned 4-byte stored copy; Huffman paths <= 30 — the
+    # pair-literal decode reads two codes of <= 15 bits each),
     # pre-phase-B refill restores >= 33, dist code <= 15 leaves >= 18
     # >= 13 extra bits. No unaligned double-gather assembly.
     def refill64(lo, hi, cnt, in_w):
         def do_refill(lo, hi, cnt, in_w):
             w = _gather_ref_win(
-                comp_ref, jnp.minimum(in_w, cw - 1)).astype(_U32)
+                comp_ref, jnp.minimum(in_w, cw - 1),
+                slab=slab).astype(_U32)
             do = cnt <= 32
             cu = jnp.minimum(cnt, 31).astype(_U32)
             lo = jnp.where(do & (cnt < 32), lo | (w << cu), lo)
@@ -570,6 +572,22 @@ def _inflate_simd_kernel(
         mlit = mok & (sym < 256)
         emit_k = jnp.where(mlit, 1, emit_k)
         packed = jnp.where(mlit, sym.astype(_U32), packed)
+        # second literal: Huffman is prefix-free, so the bits after
+        # symbol 1 are always the TRUE next symbol — decode it too and
+        # take the pair when both are literals and two bytes still fit
+        # the current output word (off <= 2, so the emit path is
+        # unchanged). Literal runs dominate the superstep count once
+        # long copies emit 16 bytes, so pairs nearly halve them.
+        # Bit budget: two codes <= 30 bits of the >= 33 available.
+        didx2, dbits2, dfound2 = _decode_canonical(
+            bitbuf >> dbits.astype(_U32), 15,
+            cntl_ref[...], firstl_ref[...], offl_ref[...],
+            _FCNT_L, _FFIRST_L, _FOFF_L, fixed_b)
+        sym2 = _gather(symdata, didx2)
+        mpair = mlit & dfound2 & (sym2 < 256) & (off <= 2)
+        emit_k = jnp.where(mpair, 2, emit_k)
+        packed = jnp.where(
+            mpair, sym.astype(_U32) | (sym2.astype(_U32) << 8), packed)
         # end of block
         meob = mok & (sym == 256)
         new_state = jnp.where(meob, after_block, new_state)
@@ -582,7 +600,11 @@ def _inflate_simd_kernel(
                  _mask_bits(lext)).astype(_I32)
         copy_len = jnp.where(mlen, lbase + lex_v, copy_len)
         new_state = jnp.where(mlen & ~bad_len, _DIST, new_state)
-        used = jnp.where(m, dbits + jnp.where(mlen, lext, 0), used)
+        used = jnp.where(
+            m,
+            dbits + jnp.where(mlen, lext, 0)
+            + jnp.where(mpair, dbits2, 0),
+            used)
 
         # ---- consume phase-A bits, refill for phase B ---------------
         lo, hi, cnt = consume64(lo, hi, cnt, jnp.where(live, used, zrow))
@@ -654,11 +676,11 @@ def _inflate_simd_kernel(
             r2 = jnp.where(far & elig8, jnp.minimum(bw + 2, ow - 1), -1)
             r3 = jnp.where(far & elig16, jnp.minimum(bw + 3, ow - 1), -1)
             r4 = jnp.where(far & elig16, jnp.minimum(bw + 4, ow - 1), -1)
-            return (_gather_ref_win(out_ref, r0),
-                    _gather_ref_win(out_ref, r1),
-                    _gather_ref_win(out_ref, r2),
-                    _gather_ref_win(out_ref, r3),
-                    _gather_ref_win(out_ref, r4))
+            return (_gather_ref_win(out_ref, r0, slab=slab),
+                    _gather_ref_win(out_ref, r1, slab=slab),
+                    _gather_ref_win(out_ref, r2, slab=slab),
+                    _gather_ref_win(out_ref, r3, slab=slab),
+                    _gather_ref_win(out_ref, r4, slab=slab))
 
         fw0, fw1, fw2, fw3, fw4 = lax.cond(
             jnp.any(far), far_fetch,
@@ -728,8 +750,8 @@ def _inflate_simd_kernel(
         wmax = jnp.maximum(
             jnp.maximum(jnp.max(wrow), jnp.max(wrow1)),
             jnp.maximum(jnp.max(wrow2), jnp.max(wrow3)))
-        for s in range(0, ow, _SLAB):
-            sl = min(_SLAB, ow - s)
+        for s in range(0, ow, slab):
+            sl = min(slab, ow - s)
 
             @pl.when((wmax >= s) & (wmin < s + sl))
             def _(s=s, sl=sl):
@@ -793,8 +815,12 @@ def _compiled(cw: int, ow: int, interpret: bool):
     # builds, dist phases) consume >= 3 input bits each, so cw bounds
     # the other — flush-heavy many-small-block streams stay on device
     max_steps = 2 * ow * 4 + 2 * cw * 4 + 8192
+    # big geometries (comp 4 MB + out 8 MB persistent) leave < 4 MB of
+    # scoped-vmem stack: halve the slab temps there
+    slab = 1024 if cw + ow >= 20480 else _SLAB
     kernel = functools.partial(
-        _inflate_simd_kernel, cw=cw, ow=ow, max_steps=max_steps)
+        _inflate_simd_kernel, cw=cw, ow=ow, max_steps=max_steps,
+        slab=slab)
     t16 = pltpu.VMEM((16, LANES), _U32)
     t8 = pltpu.VMEM((8, LANES), _U32)
     call = pl.pallas_call(
