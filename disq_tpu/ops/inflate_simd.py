@@ -72,6 +72,14 @@ from disq_tpu.ops.inflate import (
 )
 
 LANES = 128
+
+# Cumulative dispatch diagnostics (callers snapshot before/after):
+# device_lanes = payloads decoded in-kernel; host_big = payloads over
+# the comp cap routed to host by design; host_fallback = lanes the
+# kernel flagged (nonzero status / usize mismatch) that host zlib then
+# re-inflated — for well-formed in-cap streams this must stay 0.
+last_stats = {"device_lanes": 0, "host_big": 0, "host_fallback": 0}
+
 _MAXLENS = 320          # 288 lit/len + 32 dist code lengths
 _SLAB = 2048            # slab rows for big-buffer one-hot ops (VMEM temps)
 RING_W = 1024           # history ring: last 4 KiB per lane, word rows
@@ -907,6 +915,7 @@ def inflate_payloads_simd(
         import zlib as _z
 
         def _host(p):
+            last_stats["host_big"] += 1
             try:
                 return _z.decompress(p, wbits=-15)
             except _z.error as e:
@@ -960,6 +969,7 @@ def inflate_payloads_simd(
             n, status = int(meta[0, i]), int(meta[1, i])
             expect = None if usizes is None else int(usizes[lo + i])
             if status != 0 or (expect is not None and n != expect):
+                last_stats["host_fallback"] += 1
                 try:
                     host = zlib.decompress(p, wbits=-15)
                 except zlib.error as e:
@@ -974,5 +984,6 @@ def inflate_payloads_simd(
                         f"(ISIZE {expect} != {len(host)})")
                 out.append(host)
                 continue
+            last_stats["device_lanes"] += 1
             out.append(np.ascontiguousarray(words[:, i]).tobytes()[:n])
     return out

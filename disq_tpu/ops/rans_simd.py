@@ -72,6 +72,10 @@ TOTFREQ = 1 << TF_SHIFT
 
 MAX_DEVICE_CSIZE = 8192 * 4 - 16   # renorm-byte cap; bigger -> host
 MAX_DEVICE_RAW = 65536             # output cap; bigger -> host
+
+# Cumulative dispatch diagnostics (callers snapshot before/after), same
+# contract as ops/inflate_simd.last_stats.
+last_stats = {"device_lanes": 0, "host_big": 0, "host_fallback": 0}
 _U32 = jnp.uint32
 _I32 = jnp.int32
 
@@ -308,6 +312,7 @@ def rans0_decode_simd(
     ]
     if not live:
         for k in big:
+            last_stats["host_big"] += 1
             out[k] = _host_decode0(streams[k])
         return [o if o is not None else b"" for o in out]
 
@@ -327,6 +332,7 @@ def rans0_decode_simd(
     # oversize streams decode on host while the first window is in
     # flight on device
     for k in big:
+        last_stats["host_big"] += 1
         out[k] = _host_decode0(streams[k])
     for ci, chunk in enumerate(chunks):
         words, meta = launched[ci]
@@ -338,8 +344,10 @@ def rans0_decode_simd(
         for i, k in enumerate(chunk):
             raw_size = metas[k][0]
             if int(meta[1, i]) != 0:
+                last_stats["host_fallback"] += 1
                 out[k] = _host_decode0(streams[k])
             else:
+                last_stats["device_lanes"] += 1
                 out[k] = np.ascontiguousarray(
                     words[:, i]).tobytes()[:raw_size]
     return [o if o is not None else b"" for o in out]

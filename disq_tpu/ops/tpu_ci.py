@@ -245,6 +245,111 @@ def run_rans_simd(results: list) -> None:
     assert ok_k, "SIMD rANS kernel-only launch output != host codec"
 
 
+def run_kernel_fuzz(results: list) -> None:
+    """On-chip differential fuzz: mixed payload shapes (motif repeats,
+    runs, small alphabets, text-like, single-byte, short periods,
+    multi-block full-flush) across zlib levels/strategies vs host
+    zlib, plus random rANS streams vs the host codec — the compiled
+    Mosaic kernels must never diverge (interpret-mode tests cannot
+    catch miscompiles)."""
+    from disq_tpu.cram.rans import rans_encode_order0
+    from disq_tpu.ops.inflate_simd import (
+        MAX_DEVICE_CSIZE, inflate_payloads_simd,
+    )
+    from disq_tpu.ops.rans_simd import rans0_decode_simd
+
+    rng = np.random.default_rng(123)
+
+    def z(data, level, strategy):
+        c = zlib.compressobj(level, zlib.DEFLATED, -15, 8, strategy)
+        return c.compress(data) + c.flush()
+
+    def gen(i):
+        kind = i % 7
+        n = int(rng.integers(1, 60000))
+        if kind == 0:
+            m = rng.integers(0, 16, int(rng.integers(4, 4096)),
+                             dtype=np.uint8)
+            raw = np.tile(m, n // len(m) + 1)[:n].tobytes()
+        elif kind == 1:
+            raw = np.repeat(rng.integers(0, 250, max(1, n // 17),
+                                         dtype=np.uint8), 17)[:n].tobytes()
+        elif kind == 2:
+            raw = rng.integers(0, 7, n, dtype=np.uint8).tobytes()
+        elif kind == 3:
+            raw = rng.choice(
+                np.frombuffer(b"ACGTacgt =\n,the", np.uint8), n).tobytes()
+        elif kind == 4:
+            raw = bytes([int(rng.integers(0, 256))]) * n
+        elif kind == 5:
+            d = int(rng.integers(1, 9))
+            raw = (bytes(range(d)) * (n // d + 1))[:n]
+        else:
+            c = zlib.compressobj(int(rng.integers(1, 10)),
+                                 zlib.DEFLATED, -15, 8)
+            parts, out, left = [], b"", n
+            while left > 0:
+                k = min(left, int(rng.integers(1, 8000)))
+                seg = rng.integers(0, 30, k, dtype=np.uint8).tobytes()
+                parts.append(seg)
+                out += c.compress(seg)
+                if rng.random() < 0.5:
+                    out += c.flush(zlib.Z_FULL_FLUSH)
+                left -= k
+            return b"".join(parts), out + c.flush()
+        strat = [zlib.Z_DEFAULT_STRATEGY, zlib.Z_FIXED,
+                 zlib.Z_FILTERED][i % 3]
+        return raw, z(raw, int(rng.integers(1, 10)), strat)
+
+    from disq_tpu.ops import inflate_simd as _inf
+    from disq_tpu.ops import rans_simd as _rns
+
+    # the silent host fallback would mask kernel divergences (a lane
+    # that errors or mis-sizes is re-inflated by the oracle itself), so
+    # count fallbacks and require zero: every lane decoded ON DEVICE
+    inf0 = dict(_inf.last_stats)
+    rns0 = dict(_rns.last_stats)
+    bad = 0
+    for rnd in range(2):
+        raws, payloads = [], []
+        while len(raws) < 128:
+            r, p = gen(len(raws) + rnd * 128)
+            if len(p) <= MAX_DEVICE_CSIZE and len(r) <= 65536:
+                raws.append(r)
+                payloads.append(p)
+        got = inflate_payloads_simd(
+            payloads, usizes=[len(r) for r in raws], interpret=False)
+        bad += sum(g != r for g, r in zip(got, raws))
+    r_raws, r_streams = [], []
+    while len(r_raws) < 128:
+        n = int(rng.integers(0, 40000))
+        a = int(rng.integers(1, 250))
+        r = rng.integers(0, a, n, dtype=np.uint8).tobytes()
+        s = rans_encode_order0(r)
+        # keep every stream within the device caps — oversize streams
+        # would be host-vs-host comparisons that can never fail
+        if len(s) - 9 <= _rns.MAX_DEVICE_CSIZE:
+            r_raws.append(r)
+            r_streams.append(s)
+    r_got = rans0_decode_simd(r_streams, interpret=False)
+    bad += sum(g != r for g, r in zip(r_got, r_raws))
+    inf_fb = _inf.last_stats["host_fallback"] - inf0["host_fallback"]
+    inf_big = _inf.last_stats["host_big"] - inf0["host_big"]
+    rns_fb = _rns.last_stats["host_fallback"] - rns0["host_fallback"]
+    rns_big = _rns.last_stats["host_big"] - rns0["host_big"]
+    results.append({
+        "kernel": "on_chip_differential_fuzz",
+        "shape": "256 DEFLATE (7 shapes x levels x strategies) + 128 rANS",
+        "mismatches": bad,
+        "host_fallback_lanes": inf_fb + rns_fb,
+        "host_big_lanes": inf_big + rns_big,
+        "correct": bad == 0 and inf_fb + rns_fb + inf_big + rns_big == 0,
+    })
+    assert bad == 0, f"{bad} on-chip kernel divergences from host oracles"
+    assert inf_fb + rns_fb == 0, "kernel lanes silently fell back to host"
+    assert inf_big + rns_big == 0, "fuzz payloads escaped the device caps"
+
+
 def run_deflate(results: list) -> None:
     """Device DEFLATE encoder: committed ratio + throughput vs the
     canonical zlib-6 pin on realistic payloads, with the stored-block
@@ -348,7 +453,8 @@ def main(out_path: str = "TPU_KERNELS.json") -> int:
     results: list = []
     for fn in (run_inflate_simd, run_inflate_simd_literal_heavy,
                run_inflate_legacy, run_rans,
-               run_rans_simd, run_deflate, run_device_pipeline_row):
+               run_rans_simd, run_kernel_fuzz, run_deflate,
+               run_device_pipeline_row):
         try:
             fn(results)
         except Exception as e:  # record the failure, keep going
