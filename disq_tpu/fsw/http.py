@@ -50,13 +50,14 @@ def rewrite_remote_uri(path: str) -> str:
 
 class _Stats:
     __slots__ = ("range_requests", "bytes_fetched", "prefetch_hits",
-                 "prefetch_issued")
+                 "prefetch_issued", "retries")
 
     def __init__(self) -> None:
         self.range_requests = 0
         self.bytes_fetched = 0
         self.prefetch_hits = 0
         self.prefetch_issued = 0
+        self.retries = 0
 
 
 class HttpFileSystemWrapper(FileSystemWrapper):
@@ -88,15 +89,64 @@ class HttpFileSystemWrapper(FileSystemWrapper):
 
     # -- plumbing ----------------------------------------------------------
 
+    _RETRIES = 3          # transient-failure retries (5xx / network)
+    _BACKOFF_S = 0.1      # doubled per attempt
+    _TIMEOUT_S = 60.0     # per-request; a stalled connection must fail
+                          # into the retry loop, not hang a worker
+
     def _fetch(self, url: str, start: int, end_incl: int) -> bytes:
-        req = urllib.request.Request(
-            url, headers={"Range": f"bytes={start}-{end_incl}"})
-        with urllib.request.urlopen(req) as resp:
-            data = resp.read()
-        with self._lock:
-            self.stats.range_requests += 1
-            self.stats.bytes_fetched += len(data)
-        return data
+        """One ranged GET with bounded retry on transient failures —
+        the Hadoop-FS retry role. Client errors (4xx) raise
+        immediately; 5xx, network errors, truncated bodies and stalls
+        back off and retry. A server ignoring Range (200 with the whole
+        object) is sliced, accounted at its REAL transfer size, and
+        seeds the block cache so a scan doesn't re-download the object
+        per block."""
+        import http.client
+        import time
+
+        last = None
+        for attempt in range(self._RETRIES + 1):
+            if attempt:
+                with self._lock:
+                    self.stats.retries += 1
+                time.sleep(self._BACKOFF_S * (2 ** (attempt - 1)))
+            try:
+                req = urllib.request.Request(
+                    url, headers={"Range": f"bytes={start}-{end_incl}"})
+                with urllib.request.urlopen(
+                        req, timeout=self._TIMEOUT_S) as resp:
+                    data = resp.read()
+                    full = data if resp.status == 200 else None
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    raise
+                last = e
+                continue
+            except (urllib.error.URLError, http.client.HTTPException,
+                    OSError, TimeoutError) as e:
+                last = e
+                continue
+            if full is not None:
+                data = full[start: end_incl + 1]
+                bs = self.block_size
+                want = start // bs
+                with self._lock:
+                    self.stats.range_requests += 1
+                    self.stats.bytes_fetched += len(full)
+                    for bi in range((len(full) + bs - 1) // bs):
+                        if bi != want:
+                            self._cache_put(
+                                (url, bi), full[bi * bs: (bi + 1) * bs])
+                    # the requested block last, so LRU keeps it
+                    self._cache_put(
+                        (url, want), full[want * bs: (want + 1) * bs])
+            else:
+                with self._lock:
+                    self.stats.range_requests += 1
+                    self.stats.bytes_fetched += len(data)
+            return data
+        raise last
 
     def _block(self, url: str, idx: int, length: int) -> bytes:
         key = (url, idx)

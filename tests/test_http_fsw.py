@@ -122,3 +122,117 @@ def test_remote_write_rejected(http_server):
     fs = HttpFileSystemWrapper()
     with pytest.raises(NotImplementedError, match="read-only"):
         fs.create(http_server + "/out.bam")
+
+
+class TestTransientRetry:
+    """The Hadoop-FS retry role: 5xx/network blips back off and retry;
+    client errors fail fast."""
+
+    def _serve(self, handler_cls):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv
+
+    def test_503_then_success_retries(self):
+        payload = os.urandom(50_000)
+
+        class Flaky(_RangeHandler):
+            files = {"/f.bin": payload}
+            fails = {"n": 2}
+
+            def do_GET(self):
+                if self.fails["n"] > 0:
+                    self.fails["n"] -= 1
+                    self.send_error(503)
+                    return
+                super().do_GET()
+
+        srv = self._serve(Flaky)
+        try:
+            fs = HttpFileSystemWrapper(block_size=16_384)
+            fs._BACKOFF_S = 0.01
+            url = f"http://127.0.0.1:{srv.server_address[1]}/f.bin"
+            got = fs.read_range(url, 1000, 30_000)
+            assert got == payload[1000:31_000]
+            assert fs.stats.retries >= 2
+        finally:
+            srv.shutdown()
+
+    def test_404_fails_fast_no_retry(self):
+        # HEAD succeeds (so the GET path genuinely runs) but every GET
+        # 404s: the 4xx fast-fail branch must raise without retrying
+        class GoneAfterHead(_RangeHandler):
+            files = {}
+            calls = {"n": 0}
+
+            def do_GET(self):
+                self.calls["n"] += 1
+                self.send_error(404)
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "100000")
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+        srv = self._serve(GoneAfterHead)
+        try:
+            fs = HttpFileSystemWrapper(block_size=16_384, prefetch=False)
+            fs._BACKOFF_S = 0.01
+            url = f"http://127.0.0.1:{srv.server_address[1]}/nope"
+            with pytest.raises(Exception):
+                fs.read_range(url, 0, 10)
+            assert GoneAfterHead.calls["n"] == 1  # no retry storm on 4xx
+            assert fs.stats.retries == 0
+        finally:
+            srv.shutdown()
+
+    def test_range_ignoring_server_sliced(self):
+        payload = os.urandom(40_000)
+
+        class NoRange(_RangeHandler):
+            files = {"/f.bin": payload}
+
+            def do_GET(self):
+                # ignores Range entirely: 200 + whole object
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        srv = self._serve(NoRange)
+        try:
+            fs = HttpFileSystemWrapper(block_size=16_384)
+            url = f"http://127.0.0.1:{srv.server_address[1]}/f.bin"
+            got = fs.read_range(url, 5_000, 20_000)
+            assert got == payload[5_000:25_000]
+        finally:
+            srv.shutdown()
+
+    def test_range_ignoring_server_downloads_once(self):
+        payload = os.urandom(100_000)
+
+        class NoRange(_RangeHandler):
+            files = {"/f.bin": payload}
+            gets = {"n": 0}
+
+            def do_GET(self):
+                self.gets["n"] += 1
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        srv = self._serve(NoRange)
+        try:
+            fs = HttpFileSystemWrapper(block_size=16_384, prefetch=False)
+            url = f"http://127.0.0.1:{srv.server_address[1]}/f.bin"
+            got = fs.read_range(url, 0, len(payload))
+            assert got == payload
+            # the 200 full-object response seeds the block cache: one
+            # GET serves the whole scan, and stats count REAL transfer
+            assert NoRange.gets["n"] == 1
+            assert fs.stats.bytes_fetched == len(payload)
+        finally:
+            srv.shutdown()
