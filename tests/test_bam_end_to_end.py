@@ -166,3 +166,43 @@ class TestEmptyAndTiny:
         ReadsStorage.make_default().write(ds, out)
         _, refs, got = parse_bam(open(out, "rb").read())
         assert got == [] and refs == DEFAULT_REFS
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DISQ_TPU_STRESS"),
+    reason="opt-in scale stress (DISQ_TPU_STRESS=1); the 1M-record "
+           "version runs out-of-suite")
+def test_scale_stress_pipeline(tmp_path):
+    """200k records through read -> sort -> write BAM+BAI -> re-read ->
+    CRAM round-trip; catches scale-dependent bugs (offset widths,
+    ragged-matrix caps, fallback paths) the small fixtures cannot."""
+    import numpy as np
+
+    from disq_tpu.api import (
+        BaiWriteOption,
+        ReadsFormatWriteOption,
+        ReadsStorage,
+        SbiWriteOption,
+    )
+    from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+    recs = synth_records(200_000, seed=97, sorted_coord=False)
+    src = tmp_path / "in.bam"
+    src.write_bytes(make_bam_bytes(DEFAULT_REFS, recs))
+    st = ReadsStorage.make_default()
+    ds = st.read(str(src))
+    assert ds.count() == 200_000
+    out = tmp_path / "o.bam"
+    st.write(ds.coordinate_sorted(), str(out),
+             BaiWriteOption.ENABLE, SbiWriteOption.ENABLE)
+    back = st.read(str(out))
+    assert back.count() == 200_000
+    assert np.array_equal(
+        np.sort(np.asarray(back.reads.pos)),
+        np.sort(np.asarray(ds.reads.pos)))
+    cram = tmp_path / "o.cram"
+    st.write(back, str(cram), ReadsFormatWriteOption.CRAM)
+    c = st.read(str(cram))
+    assert c.count() == 200_000
+    assert np.array_equal(c.reads.pos, back.reads.pos)
+    assert np.array_equal(c.reads.seqs, back.reads.seqs)
