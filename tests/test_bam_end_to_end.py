@@ -176,15 +176,7 @@ def test_scale_stress_pipeline(tmp_path):
     """200k records through read -> sort -> write BAM+BAI -> re-read ->
     CRAM round-trip; catches scale-dependent bugs (offset widths,
     ragged-matrix caps, fallback paths) the small fixtures cannot."""
-    import numpy as np
-
-    from disq_tpu.api import (
-        BaiWriteOption,
-        ReadsFormatWriteOption,
-        ReadsStorage,
-        SbiWriteOption,
-    )
-    from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+    from disq_tpu.api import ReadsFormatWriteOption
 
     recs = synth_records(200_000, seed=97, sorted_coord=False)
     src = tmp_path / "in.bam"
