@@ -1156,37 +1156,136 @@ def _decode_slice(
             tag_entries.append((key, val))
         # features (MQ follows them — CRAM 3.0 record layout)
         fn = cols["FN"][i] if cols is not None else rd.read_int(enc["FN"])
-        features = []
-        fpos = 0
-        for _ in range(fn):
-            if fstreams is not None:
-                code = chr(fstreams[0][fidx])
-                fpos += fstreams[1][fidx]
-                fidx += 1
-            else:
-                code = chr(rd.read_byte(enc["FC"]))
-                fpos += rd.read_int(enc["FP"])
-            if code == "b":
-                if bb_vals is not None:
-                    payload = bb_vals[bidx]
-                    bidx += 1
+        # fast shape: exactly one whole-read 'b' feature at read
+        # position 1 (the dominant reference-less record) — equivalent
+        # to the generic reconstruction with no gap, no tail and a
+        # single M run; unmapped flags clear the CIGAR as below
+        if (fstreams is not None and bb_vals is not None and fn == 1
+                and not (cf & CF_UNKNOWN_BASES)
+                and fstreams[0][fidx] == 98          # ord('b')
+                and fstreams[1][fidx] == 1
+                and rl > 0 and len(bb_vals[bidx]) == rl):
+            fidx += 1
+            payload = bb_vals[bidx]
+            bidx += 1
+            pos0 = ap - 1
+            seq = _CHAR_TO_NT16[np.frombuffer(payload, np.uint8)]
+            cigar_ops = [] if flag & 0x4 else [rl << 4]
+        else:
+            features = []
+            fpos = 0
+            for _ in range(fn):
+                if fstreams is not None:
+                    code = chr(fstreams[0][fidx])
+                    fpos += fstreams[1][fidx]
+                    fidx += 1
                 else:
-                    payload = rd.read_array(enc["BB"])
-            elif code == "I":
-                payload = rd.read_array(enc["IN"])
-            elif code == "S":
-                payload = rd.read_array(enc["SC"])
-            elif code == "D":
-                payload = rd.read_int(enc["DL"])
-            elif code == "N":
-                payload = rd.read_int(enc["RS"])
-            elif code == "H":
-                payload = rd.read_int(enc["HC"])
-            elif code == "P":
-                payload = rd.read_int(enc["PD"])
-            else:
-                raise ValueError(f"unsupported read feature {code!r}")
-            features.append((fpos, code, payload))
+                    code = chr(rd.read_byte(enc["FC"]))
+                    fpos += rd.read_int(enc["FP"])
+                if code == "b":
+                    if bb_vals is not None:
+                        payload = bb_vals[bidx]
+                        bidx += 1
+                    else:
+                        payload = rd.read_array(enc["BB"])
+                elif code == "I":
+                    payload = rd.read_array(enc["IN"])
+                elif code == "S":
+                    payload = rd.read_array(enc["SC"])
+                elif code == "D":
+                    payload = rd.read_int(enc["DL"])
+                elif code == "N":
+                    payload = rd.read_int(enc["RS"])
+                elif code == "H":
+                    payload = rd.read_int(enc["HC"])
+                elif code == "P":
+                    payload = rd.read_int(enc["PD"])
+                else:
+                    raise ValueError(f"unsupported read feature {code!r}")
+                features.append((fpos, code, payload))
+
+            # reconstruct seq + cigar
+            pos0 = ap - 1
+            seq = np.zeros(rl, dtype=np.uint8)
+            cigar_ops: List[int] = []
+
+            def push(op_char: str, ln: int):
+                if ln <= 0:
+                    return
+                op = "MIDNSHP=X".index(op_char)
+                if cigar_ops and (cigar_ops[-1] & 0xF) == op:
+                    cigar_ops[-1] += ln << 4
+                else:
+                    cigar_ops.append((ln << 4) | op)
+
+            rp = 1
+            ref_pos = pos0
+            if cf & CF_UNKNOWN_BASES:
+                features = []
+            for fpos, code, payload in features:
+                gap = fpos - rp
+                if gap > 0:
+                    # reference-matching M stretch
+                    if ref_fetch is None:
+                        raise ValueError(
+                            "reference required to decode this CRAM slice "
+                            "(set reference_source_path)"
+                        )
+                    rb = ref_fetch(int(refid_l[i]), ref_pos, gap)
+                    if rb is None or len(rb) < gap:
+                        raise ValueError(
+                            f"reference contig for refid {int(refid_l[i])} is "
+                            f"missing or too short in the configured FASTA"
+                        )
+                    seq[rp - 1: rp - 1 + gap] = _CHAR_TO_NT16[
+                        np.frombuffer(rb.upper(), np.uint8)
+                    ]
+                    push("M", gap)
+                    rp += gap
+                    ref_pos += gap
+                if code == "b":
+                    ln = len(payload)
+                    seq[rp - 1: rp - 1 + ln] = _CHAR_TO_NT16[
+                        np.frombuffer(payload, np.uint8)
+                    ]
+                    push("M", ln)
+                    rp += ln
+                    ref_pos += ln
+                elif code in ("I", "S"):
+                    ln = len(payload)
+                    seq[rp - 1: rp - 1 + ln] = _CHAR_TO_NT16[
+                        np.frombuffer(payload, np.uint8)
+                    ]
+                    push(code, ln)
+                    rp += ln
+                elif code in ("D", "N"):
+                    push(code, payload)
+                    ref_pos += payload
+                elif code in ("H", "P"):
+                    push(code, payload)
+            tail = rl - (rp - 1)
+            if tail > 0 and not (cf & CF_UNKNOWN_BASES):
+                if (flag & 0x4) == 0 and int(refid_l[i]) >= 0:
+                    if ref_fetch is None:
+                        raise ValueError(
+                            "reference required to decode this CRAM slice "
+                            "(set reference_source_path)"
+                        )
+                    rb = ref_fetch(int(refid_l[i]), ref_pos, tail)
+                    if rb is None or len(rb) < tail:
+                        raise ValueError(
+                            f"reference contig for refid {int(refid_l[i])} is "
+                            f"missing or too short in the configured FASTA"
+                        )
+                    seq[rp - 1:] = _CHAR_TO_NT16[np.frombuffer(rb.upper(), np.uint8)]
+                    push("M", tail)
+                else:
+                    raise ValueError("unmapped record with missing base features")
+
+            if flag & 0x4:
+                # Unmapped records carry no CIGAR ('*'); any cover-all 'b'
+                # feature existed only to transport the bases.
+                cigar_ops = []
         mq = cols["MQ"][i] if cols is not None else rd.read_int(enc["MQ"])
         if qs_blob is not None:
             quals = qs_blob[qoff: qoff + rl]
@@ -1194,89 +1293,6 @@ def _decode_slice(
         else:
             quals = (rd.read_bytes_len(enc["QS"], rl)
                      if cf & CF_QS_STORED else b"\xff" * rl)
-
-        # reconstruct seq + cigar
-        pos0 = ap - 1
-        seq = np.zeros(rl, dtype=np.uint8)
-        cigar_ops: List[int] = []
-
-        def push(op_char: str, ln: int):
-            if ln <= 0:
-                return
-            op = "MIDNSHP=X".index(op_char)
-            if cigar_ops and (cigar_ops[-1] & 0xF) == op:
-                cigar_ops[-1] += ln << 4
-            else:
-                cigar_ops.append((ln << 4) | op)
-
-        rp = 1
-        ref_pos = pos0
-        if cf & CF_UNKNOWN_BASES:
-            features = []
-        for fpos, code, payload in features:
-            gap = fpos - rp
-            if gap > 0:
-                # reference-matching M stretch
-                if ref_fetch is None:
-                    raise ValueError(
-                        "reference required to decode this CRAM slice "
-                        "(set reference_source_path)"
-                    )
-                rb = ref_fetch(int(refid_l[i]), ref_pos, gap)
-                if rb is None or len(rb) < gap:
-                    raise ValueError(
-                        f"reference contig for refid {int(refid_l[i])} is "
-                        f"missing or too short in the configured FASTA"
-                    )
-                seq[rp - 1: rp - 1 + gap] = _CHAR_TO_NT16[
-                    np.frombuffer(rb.upper(), np.uint8)
-                ]
-                push("M", gap)
-                rp += gap
-                ref_pos += gap
-            if code == "b":
-                ln = len(payload)
-                seq[rp - 1: rp - 1 + ln] = _CHAR_TO_NT16[
-                    np.frombuffer(payload, np.uint8)
-                ]
-                push("M", ln)
-                rp += ln
-                ref_pos += ln
-            elif code in ("I", "S"):
-                ln = len(payload)
-                seq[rp - 1: rp - 1 + ln] = _CHAR_TO_NT16[
-                    np.frombuffer(payload, np.uint8)
-                ]
-                push(code, ln)
-                rp += ln
-            elif code in ("D", "N"):
-                push(code, payload)
-                ref_pos += payload
-            elif code in ("H", "P"):
-                push(code, payload)
-        tail = rl - (rp - 1)
-        if tail > 0 and not (cf & CF_UNKNOWN_BASES):
-            if (flag & 0x4) == 0 and int(refid_l[i]) >= 0:
-                if ref_fetch is None:
-                    raise ValueError(
-                        "reference required to decode this CRAM slice "
-                        "(set reference_source_path)"
-                    )
-                rb = ref_fetch(int(refid_l[i]), ref_pos, tail)
-                if rb is None or len(rb) < tail:
-                    raise ValueError(
-                        f"reference contig for refid {int(refid_l[i])} is "
-                        f"missing or too short in the configured FASTA"
-                    )
-                seq[rp - 1:] = _CHAR_TO_NT16[np.frombuffer(rb.upper(), np.uint8)]
-                push("M", tail)
-            else:
-                raise ValueError("unmapped record with missing base features")
-
-        if flag & 0x4:
-            # Unmapped records carry no CIGAR ('*'); any cover-all 'b'
-            # feature existed only to transport the bases.
-            cigar_ops = []
         pos_l[i] = pos0
         mapq_l[i] = mq
         flag_l[i] = flag
