@@ -43,7 +43,11 @@ from disq_tpu.api import (  # noqa: F401
     StageManifestWriteOption,
 )
 from disq_tpu.runtime import (  # noqa: F401
+    CorruptBlockError,
+    DisqOptions,
+    ErrorPolicy,
     PipelineCounters,
+    QuarantineManifest,
     ShardCounters,
     StageManifest,
     phase_report,

@@ -33,6 +33,10 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from disq_tpu.runtime.errors import DisqOptions, ErrorPolicy  # noqa: F401
+# (re-exported here: the error-policy knob is part of the public read
+# surface — ``ReadsStorage.make_default().error_policy("skip")``.)
+
 
 class WriteOption:
     """Marker base for varargs write options (ref: ``WriteOption.java``)."""
@@ -182,10 +186,15 @@ class ReadsDataset:
 
 @dataclass
 class VariantsDataset:
-    """Header + columnar variants (ref: ``HtsjdkVariantsRdd.java``)."""
+    """Header + columnar variants (ref: ``HtsjdkVariantsRdd.java``).
+
+    ``counters``, when present, holds the reduced per-shard counters
+    including error-policy observability (skipped / quarantined /
+    retried; SURVEY.md §5)."""
 
     header: "VcfHeader"
     variants: "VariantBatch"
+    counters: object = None
 
     def count(self) -> int:
         return int(self.variants.count)
@@ -225,6 +234,7 @@ class ReadsStorage:
         self._stringency = ValidationStringency.STRICT
         self._reference_source_path: Optional[str] = None
         self._num_shards: Optional[int] = None
+        self._options = DisqOptions()
 
     @classmethod
     def make_default(cls) -> "ReadsStorage":
@@ -232,6 +242,19 @@ class ReadsStorage:
 
     def split_size(self, n: int) -> "ReadsStorage":
         self._split_size = n
+        return self
+
+    def error_policy(self, policy: "ErrorPolicy | str") -> "ReadsStorage":
+        """Corrupt-block policy for reads: ``strict`` (default — raise
+        ``CorruptBlockError`` with coordinates), ``skip`` (drop + count)
+        or ``quarantine`` (drop + copy to the quarantine sidecar)."""
+        self._options = self._options.with_policy(policy)
+        return self
+
+    def options(self, opts: DisqOptions) -> "ReadsStorage":
+        """Replace the full read-path option set (retry budget, backoff,
+        quarantine dir) in one call."""
+        self._options = opts
         return self
 
     def num_shards(self, n: int) -> "ReadsStorage":
@@ -284,6 +307,7 @@ class VariantsStorage:
     def __init__(self) -> None:
         self._split_size: int = 128 * 1024 * 1024
         self._num_shards: Optional[int] = None
+        self._options = DisqOptions()
 
     @classmethod
     def make_default(cls) -> "VariantsStorage":
@@ -291,6 +315,14 @@ class VariantsStorage:
 
     def split_size(self, n: int) -> "VariantsStorage":
         self._split_size = n
+        return self
+
+    def error_policy(self, policy: "ErrorPolicy | str") -> "VariantsStorage":
+        self._options = self._options.with_policy(policy)
+        return self
+
+    def options(self, opts: DisqOptions) -> "VariantsStorage":
+        self._options = opts
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

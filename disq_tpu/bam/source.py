@@ -19,13 +19,25 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from disq_tpu.bam.codec import decode_records, scan_record_offsets
+from disq_tpu.bam.codec import (
+    decode_records,
+    scan_record_offsets,
+    scan_record_offsets_tolerant,
+)
 from disq_tpu.bam.columnar import ReadBatch
 from disq_tpu.bam.guesser import BamRecordGuesser
 from disq_tpu.bam.header import SamHeader
-from disq_tpu.bgzf.block import BGZF_EOF_MARKER, make_virtual_offset
+from disq_tpu.bgzf.block import (
+    BGZF_EOF_MARKER,
+    BgzfBlock,
+    make_virtual_offset,
+)
 from disq_tpu.bgzf.codec import BgzfReader, inflate_blocks
-from disq_tpu.bgzf.guesser import BgzfBlockGuesser, _walk_blocks_collect
+from disq_tpu.bgzf.guesser import (
+    BgzfBlockGuesser,
+    _walk_blocks_collect,
+    walk_blocks_salvage,
+)
 from disq_tpu.fsw.filesystem import (
     FileSystemWrapper,
     PathSplit,
@@ -64,25 +76,40 @@ class BamSource:
             trace_phase,
         )
 
+        from disq_tpu.runtime.errors import context_for_storage
+
         fs, path = resolve_path(path)
+        ctx = context_for_storage(self._storage, path)
         with trace_phase("bam.read.header"):
-            header, first_voffset = read_header(fs, path)
+            header, first_voffset = ctx.retrier.call(
+                read_header, fs, path, what="header")
         if traversal is not None:
             from disq_tpu.traversal.bai_query import read_with_traversal
 
+            # Index-driven reads retry transient faults whole-phase (the
+            # read is bounded by the queried intervals); corrupt blocks
+            # inside the traversal always raise, regardless of policy.
             with trace_phase("bam.read.traversal"):
-                batch = read_with_traversal(fs, path, header, traversal, self)
-            return ReadsDataset(header=header, reads=batch)
+                batch = ctx.retrier.call(
+                    read_with_traversal, fs, path, header, traversal, self,
+                    what="traversal",
+                )
+            counters = reduce_counters([])
+            counters.retried_reads += ctx.retrier.retried
+            return ReadsDataset(header=header, reads=batch,
+                                counters=counters)
         with trace_phase("bam.read.splits"):
-            batches = self.read_split_batches(fs, path, header, first_voffset)
+            batches = self.read_split_batches(
+                fs, path, header, first_voffset, ctx=ctx)
             batch = ReadBatch.concat(batches)
         if debug_enabled():
             check_read_batch(batch, n_ref=header.n_ref)
-        return ReadsDataset(
-            header=header,
-            reads=batch,
-            counters=reduce_counters(self._last_counters),
-        )
+        counters = reduce_counters(self._last_counters)
+        # Header/boundary-phase retries happened outside any shard.
+        counters.retried_reads += ctx.retrier.retried
+        counters.skipped_blocks += ctx.skipped_blocks
+        counters.quarantined_blocks += ctx.quarantined_blocks
+        return ReadsDataset(header=header, reads=batch, counters=counters)
 
     # -- split machinery ----------------------------------------------------
 
@@ -93,22 +120,34 @@ class BamSource:
         header: SamHeader,
         first_voffset: int,
         split_size: Optional[int] = None,
+        ctx=None,
     ) -> List[ReadBatch]:
         """One columnar batch per split — the unit that maps 1:1 onto
-        device shards in the distributed pipeline."""
+        device shards in the distributed pipeline. ``ctx`` (a
+        ``ShardErrorContext``) carries the error policy; each shard gets
+        its own retrier + corrupt-block counters via ``ctx.for_shard``."""
         import time
 
         from disq_tpu.runtime import ShardCounters
+        from disq_tpu.runtime.errors import context_for_storage
 
+        if ctx is None:
+            ctx = context_for_storage(self._storage, path)
         splits = compute_path_splits(fs, path, split_size or self.split_size)
-        sbi = self._try_load_sbi(fs, path)
-        boundaries = self._split_boundaries(fs, path, header, first_voffset, splits, sbi)
+        sbi = ctx.retrier.call(self._try_load_sbi, fs, path, what="sbi")
+        boundaries = self._split_boundaries(
+            fs, path, header, first_voffset, splits, sbi, ctx=ctx
+        )
         out = []
         self._last_counters = []
         for i in range(len(splits)):
             lo, hi = boundaries[i], boundaries[i + 1]
+            shard_ctx = ctx.for_shard(i)
             t0 = time.perf_counter()
-            batch, stats = self._decode_range_with_stats(fs, path, header, lo, hi)
+            batch, stats = shard_ctx.retrier.call(
+                self._decode_range_with_stats, fs, path, header, lo, hi,
+                ctx=shard_ctx, what=f"shard{i}",
+            )
             self._last_counters.append(
                 ShardCounters(
                     shard_id=i,
@@ -117,6 +156,9 @@ class BamSource:
                     bytes_compressed=stats[1],
                     bytes_uncompressed=stats[2],
                     wall_seconds=time.perf_counter() - t0,
+                    skipped_blocks=shard_ctx.skipped_blocks,
+                    quarantined_blocks=shard_ctx.quarantined_blocks,
+                    retried_reads=shard_ctx.retrier.retried,
                 )
             )
             out.append(batch)
@@ -143,17 +185,29 @@ class BamSource:
         first_voffset: int,
         splits: List[PathSplit],
         sbi: Optional[SbiIndex],
+        ctx=None,
     ) -> List[int]:
         """Virtual offsets b[0..n]: split i decodes records in
         [b[i], b[i+1]). b[0] = first record (from the header read);
-        b[n] = end of data."""
-        end_vo = self._data_end_voffset(fs, path)
+        b[n] = end of data.
+
+        Transient-fault retry is *per boundary* (each boundary guess is
+        a handful of reads), not around the whole phase — a whole-phase
+        retry would re-execute every read and never converge under a
+        sustained fault rate."""
+        def _call(fn, *args, what):
+            if ctx is None:
+                return fn(*args)
+            return ctx.retrier.call(fn, *args, what=what)
+
+        end_vo = _call(self._data_end_voffset, fs, path, what="data_end")
         bounds = [first_voffset]
         for s in splits[1:]:
             if sbi is not None:
                 vo = sbi.first_offset_at_or_after(s.start)
             else:
-                vo = self._guess_record_voffset(fs, path, header, s.start)
+                vo = _call(self._guess_record_voffset, fs, path, header,
+                           s.start, ctx, what="boundary")
                 if vo is None:
                     vo = end_vo
             bounds.append(max(min(vo, end_vo), bounds[-1]))
@@ -161,10 +215,22 @@ class BamSource:
         return bounds
 
     def _guess_record_voffset(
-        self, fs: FileSystemWrapper, path: str, header: SamHeader, file_offset: int
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        header: SamHeader,
+        file_offset: int,
+        ctx=None,
     ) -> Optional[int]:
         """First record boundary at-or-after ``file_offset`` (SURVEY §3.1:
-        BgzfBlockGuesser → BamRecordGuesser over a decompressed window)."""
+        BgzfBlockGuesser → BamRecordGuesser over a decompressed window).
+
+        Under a skip/quarantine ``ctx``, a corrupt block inside the
+        search window is stepped over *silently* (per good-block run) —
+        the shard that owns the block does the counting/quarantining
+        when it decodes; counting here would double-book it."""
+        from disq_tpu.runtime.errors import TruncatedReadError
+
         if file_offset == 0:
             raise ValueError("offset 0 is resolved by the header read")
         bg = BgzfBlockGuesser(fs, path)
@@ -178,15 +244,53 @@ class BamSource:
         # boundary is found or the window reaches EOF.
         window_csize = 4 * 0x10000
         while True:
-            window_blocks, data = _walk_blocks_collect(
-                fs, path, block_start, block_start + window_csize, file_length
-            )
+            try:
+                window_blocks, data = _walk_blocks_collect(
+                    fs, path, block_start, block_start + window_csize,
+                    file_length,
+                )
+            except TruncatedReadError:
+                raise  # short range read: retried by the phase retrier
+            except ValueError:
+                if ctx is None:
+                    raise
+                # Malformed block header in the window: salvage-walk it
+                # (silently — the owning shard books the corruption;
+                # STRICT still raises with coordinates) and search each
+                # good run.
+                from disq_tpu.runtime.errors import inflate_blocks_salvage
+
+                window_blocks, data, gaps = walk_blocks_salvage(
+                    fs, path, block_start, block_start + window_csize,
+                    file_length, ctx, owned_until=block_start,
+                )
+                if not window_blocks:
+                    return None
+                payloads = inflate_blocks_salvage(
+                    data, window_blocks, block_start, ctx.silent())
+                u_vo = self._search_payload_runs(g, window_blocks, payloads)
+                if u_vo is not None:
+                    return u_vo
+                if window_blocks[-1].end >= file_length or (
+                        gaps and gaps[-1][1] >= file_length):
+                    return None
+                window_csize *= 4
+                continue
             if not window_blocks:
                 return None
-            window = inflate_blocks(
-                data, window_blocks, base=block_start, as_array=True
-            )
-            u = g.find_first_record(window)
+            try:
+                window = inflate_blocks(
+                    data, window_blocks, base=block_start, as_array=True
+                )
+            except ValueError as e:
+                u_vo = self._guess_around_corruption(
+                    path, g, window_blocks, data, block_start, ctx, e
+                )
+                if u_vo is not None:
+                    return u_vo
+                u = None
+            else:
+                u = g.find_first_record(window)
             at_eof = window_blocks[-1].end >= file_length
             if u is not None:
                 # Map window offset u back to a (block, within) voffset
@@ -201,6 +305,55 @@ class BamSource:
             if at_eof:
                 return None
             window_csize *= 4
+
+    def _guess_around_corruption(
+        self, path, g, window_blocks, data, base, ctx, err
+    ) -> Optional[int]:
+        """Boundary search when the window holds a corrupt block: under
+        STRICT (or no ctx) apply the policy — which raises with the
+        block's coordinates; otherwise search each good run and return a
+        virtual offset directly."""
+        from disq_tpu.runtime.errors import (
+            ErrorPolicy,
+            ShardErrorContext,
+            inflate_blocks_salvage,
+        )
+
+        if ctx is None:
+            silent = ShardErrorContext(policy=ErrorPolicy.STRICT, path=path)
+        else:
+            silent = ctx.silent()
+        payloads = inflate_blocks_salvage(data, window_blocks, base, silent)
+        if all(p is not None for p in payloads):
+            raise err  # batch inflate bug, not corruption — surface it
+        return self._search_payload_runs(g, window_blocks, payloads)
+
+    def _search_payload_runs(self, g, blocks, payloads) -> Optional[int]:
+        """First record boundary across the contiguous good runs of a
+        salvaged window: each run is searched independently (never
+        spliced across a corrupt hole, which could chain-validate a
+        false boundary)."""
+        n = len(blocks)
+        i = 0
+        while i < n:
+            if payloads[i] is None:
+                i += 1
+                continue
+            j = i
+            while j + 1 < n and payloads[j + 1] is not None:
+                j += 1
+            blob = np.frombuffer(
+                b"".join(payloads[i: j + 1]), dtype=np.uint8)
+            u = g.find_first_record(blob)
+            if u is not None:
+                acc = 0
+                for k in range(i, j + 1):
+                    if u < acc + len(payloads[k]):
+                        return make_virtual_offset(
+                            blocks[k].pos, u - acc)
+                    acc += len(payloads[k])
+            i = j + 1
+        return None
 
     def _decode_range(
         self,
@@ -221,6 +374,7 @@ class BamSource:
         header: SamHeader,
         lo_voffset: int,
         hi_voffset: int,
+        ctx=None,
     ) -> Tuple[ReadBatch, Tuple[int, int, int]]:
         """Decode all records whose start lies in [lo, hi) virtual space.
 
@@ -231,7 +385,25 @@ class BamSource:
         ``pos ∈ [lo_block, hi_block)`` — so a block straddling a split
         boundary is attributed to exactly one side and reduced totals
         match the file.
+
+        ``ctx`` (``ShardErrorContext``) governs corrupt blocks: the
+        fault-free fast path is the one batched inflate below; only when
+        it fails does the per-block salvage path run, applying the
+        policy (strict raise with coordinates / skip / quarantine).
         """
+        from disq_tpu.runtime.errors import (
+            ErrorPolicy,
+            ShardErrorContext,
+            TruncatedReadError,
+            inflate_blocks_salvage,
+        )
+
+        if ctx is None:
+            ctx = ShardErrorContext(policy=ErrorPolicy.STRICT, path=path)
+        # A retried attempt must not double-count the previous attempt's
+        # corrupt blocks (quarantine sidecar writes are idempotent).
+        ctx.skipped_blocks = 0
+        ctx.quarantined_blocks = 0
         if hi_voffset <= lo_voffset:
             return ReadBatch.empty(), (0, 0, 0)
         lo_block, lo_u = lo_voffset >> 16, lo_voffset & 0xFFFF
@@ -240,9 +412,21 @@ class BamSource:
         # Walk blocks from lo_block through hi_block (inclusive iff hi_u>0);
         # the walk stages the compressed bytes so inflation re-uses them.
         want_end = hi_block + (1 if hi_u > 0 else 0)
-        blocks, data = _walk_blocks_collect(
-            fs, path, lo_block, max(want_end, lo_block + 1), length
-        )
+        gaps = []
+        try:
+            blocks, data = _walk_blocks_collect(
+                fs, path, lo_block, max(want_end, lo_block + 1), length
+            )
+        except TruncatedReadError:
+            raise  # short range read: the shard retrier re-reads
+        except ValueError:
+            # A corrupt block HEADER breaks the BSIZE chain itself:
+            # re-walk one block at a time, policy-handling each corrupt
+            # span and re-syncing with the block guesser.
+            blocks, data, gaps = walk_blocks_salvage(
+                fs, path, lo_block, max(want_end, lo_block + 1), length,
+                ctx, owned_until=hi_block,
+            )
         if not blocks:
             return ReadBatch.empty(), (0, 0, 0)
         # Consecutive split ranges partition [first_block, data_end) in
@@ -255,12 +439,146 @@ class BamSource:
             sum(b.csize for b in owned),
             sum(b.usize for b in owned),
         )
-        blob = inflate_blocks(data, blocks, base=lo_block, as_array=True)
+        if gaps:
+            # Corrupt-header spans already handled by the salvage walk:
+            # inflate per block and splice None sentinels at each gap so
+            # record runs break there (a record straddling INTO a gap
+            # must not concatenate across it).
+            payloads = inflate_blocks_salvage(
+                data, blocks, lo_block, ctx, owned_until=hi_block
+            )
+            merged = sorted(
+                list(zip(blocks, payloads))
+                + [(BgzfBlock(pos=lo, csize=hi - lo, usize=0), None)
+                   for lo, hi in gaps],
+                key=lambda bp: bp[0].pos,
+            )
+            batch = self._decode_runs(
+                header, [b for b, _ in merged], [p for _, p in merged],
+                lo_u, hi_block, hi_u, ctx=ctx,
+            )
+            return batch, stats
+        try:
+            blob = inflate_blocks(data, blocks, base=lo_block, as_array=True)
+        except ValueError as first_err:
+            # At least one block is corrupt: per-block salvage under the
+            # policy (STRICT raises CorruptBlockError with coordinates).
+            payloads = inflate_blocks_salvage(
+                data, blocks, lo_block, ctx, owned_until=hi_block
+            )
+            if all(p is not None for p in payloads):
+                # The batch inflate failed but every block decodes alone:
+                # a codec-path bug, not data corruption — surface it.
+                raise first_err
+            batch = self._decode_runs(
+                header, blocks, payloads, lo_u, hi_block, hi_u, ctx=ctx
+            )
+            return batch, stats
         if hi_u > 0:
             acc_before_hi = sum(b.usize for b in blocks if b.pos < hi_block)
             end_u = acc_before_hi + hi_u
         else:
             end_u = len(blob)
         record_bytes = blob[lo_u:end_u]
-        offsets = scan_record_offsets(record_bytes)
-        return decode_records(record_bytes, offsets, n_ref=header.n_ref), stats
+        try:
+            offsets = scan_record_offsets(record_bytes)
+            batch = decode_records(record_bytes, offsets, n_ref=header.n_ref)
+        except ValueError as e:
+            # Record framing/content damage inside intact BGZF blocks
+            # (corruption that predates compression, so no single block
+            # is identifiable): STRICT raises with the shard's
+            # coordinates; skip/quarantine keep the clean prefix found
+            # by the tolerant scan.
+            ctx.handle_corrupt_block(
+                e, block_offset=lo_block, virtual_offset=lo_voffset,
+                kind="record run",
+            )
+            try:
+                offsets = scan_record_offsets_tolerant(record_bytes)
+                batch = decode_records(
+                    record_bytes, offsets, n_ref=header.n_ref)
+            except ValueError:
+                batch = ReadBatch.empty()
+        return batch, stats
+
+    def _decode_runs(
+        self,
+        header: SamHeader,
+        blocks,
+        payloads,
+        lo_u: int,
+        hi_block: int,
+        hi_u: int,
+        ctx=None,
+    ) -> ReadBatch:
+        """Decode the contiguous runs of good blocks around skipped
+        corrupt ones. A record straddling INTO a corrupt block is
+        dropped (its tail bytes are gone); after a gap, the first record
+        boundary is re-found with the ``BamRecordGuesser`` — exactly the
+        machinery that already resolves split starts. ``ctx`` governs
+        record-framing damage *inside* a good run (or a false post-gap
+        re-sync): without it the strict scan raises as before."""
+        guesser = BamRecordGuesser(
+            header.n_ref, [s.length for s in header.sequences]
+        )
+        batches: List[ReadBatch] = []
+        n = len(blocks)
+        i = 0
+        while i < n:
+            if payloads[i] is None:
+                i += 1
+                continue
+            j = i
+            while j + 1 < n and payloads[j + 1] is not None:
+                j += 1
+            run_blocks = blocks[i: j + 1]
+            run_payloads = payloads[i: j + 1]
+            blob = np.frombuffer(b"".join(run_payloads), dtype=np.uint8)
+            start_u = lo_u if i == 0 else 0
+            if hi_u > 0 and any(b.pos == hi_block for b in run_blocks):
+                end_u = (
+                    sum(len(p) for b, p in zip(run_blocks, run_payloads)
+                        if b.pos < hi_block)
+                    + hi_u
+                )
+            else:
+                end_u = len(blob)
+            seg = blob[start_u:end_u]
+            after_gap = i > 0 and payloads[i - 1] is None
+            ends_at_gap = j + 1 < n  # next block was skipped
+            if after_gap and len(seg):
+                first = guesser.find_first_record(seg)
+                if first is None:
+                    i = j + 1
+                    continue
+                seg = seg[first:]
+            if len(seg) == 0:
+                i = j + 1
+                continue
+            try:
+                offsets = (
+                    scan_record_offsets_tolerant(seg)
+                    if ends_at_gap
+                    else scan_record_offsets(seg)
+                )
+                batches.append(
+                    decode_records(seg, offsets, n_ref=header.n_ref))
+            except ValueError as e:
+                if ctx is None:
+                    raise
+                ctx.handle_corrupt_block(
+                    e, block_offset=int(run_blocks[0].pos),
+                    virtual_offset=make_virtual_offset(
+                        int(run_blocks[0].pos), 0),
+                    kind="record run",
+                )
+                try:
+                    batches.append(decode_records(
+                        seg, scan_record_offsets_tolerant(seg),
+                        n_ref=header.n_ref))
+                except ValueError:
+                    pass  # keep the other runs
+            i = j + 1
+        if not batches:
+            return ReadBatch.empty()
+        return ReadBatch.concat(batches)

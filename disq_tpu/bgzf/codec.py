@@ -318,8 +318,15 @@ class BgzfReader(io.RawIOBase):
 
     def _load_block_at(self, file_offset: int) -> bool:
         self._stream.seek(file_offset)
-        header = self._stream.read(BGZF_HEADER_SIZE)
-        if len(header) < BGZF_HEADER_SIZE:
+        # Loop on short reads (buffering/flaky streams can return fewer
+        # bytes than asked without being at EOF); b"" IS EOF.
+        header = b""
+        while len(header) < BGZF_HEADER_SIZE:
+            chunk = self._stream.read(BGZF_HEADER_SIZE - len(header))
+            if not chunk:
+                break
+            header += chunk
+        if not header:
             self._eof = True
             self._ublock = b""
             self._upos = 0
@@ -327,10 +334,26 @@ class BgzfReader(io.RawIOBase):
             # previous block start.
             self._block_start = file_offset
             return False
+        if len(header) < BGZF_HEADER_SIZE:
+            # Partial header then EOF: the file ends mid-header —
+            # deterministic at-rest damage, same classification as a
+            # mid-block EOF below.
+            raise ValueError(
+                f"BGZF file ends mid-header at {file_offset}")
         total = parse_block_header(header)
-        rest = self._stream.read(total - BGZF_HEADER_SIZE)
-        if len(rest) < total - BGZF_HEADER_SIZE:
-            raise ValueError("truncated BGZF block")
+        # Loop on short reads: a buffering stream (or a flaky remote
+        # behind one) may return fewer bytes than asked without being at
+        # EOF. A read returning b"" IS EOF — the file ends mid-block,
+        # which is deterministic at-rest damage, not a transient fault
+        # (same classification as the chain walk in bgzf/guesser.py).
+        rest = b""
+        want = total - BGZF_HEADER_SIZE
+        while len(rest) < want:
+            chunk = self._stream.read(want - len(rest))
+            if not chunk:
+                raise ValueError(
+                    f"BGZF file ends mid-block at {file_offset}")
+            rest += chunk
         self._ublock = inflate_block(header + rest)
         self._upos = 0
         self._block_start = file_offset

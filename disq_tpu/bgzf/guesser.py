@@ -178,9 +178,24 @@ def _walk_blocks_collect(
         buf = fs.read_range(path, pos, want)
         entries, consumed = _walk_buffer(buf, min(end - pos, len(buf)))
         if not entries:
-            # A whole-buffer read with no complete block: the final block
-            # runs past EOF (or the header itself is malformed).
-            raise ValueError(f"truncated BGZF block at {pos} in {path}")
+            # A whole-buffer read with no complete block. If the read
+            # came back short (a flaky remote can cut a body) the
+            # failure is retryable: TruncatedReadError subclasses
+            # ValueError (callers treating this as corrupt still catch
+            # it) while the shard retrier classifies it transient. But
+            # if every requested byte arrived and the buffer reaches
+            # EOF, the FILE ends mid-block — deterministic at-rest
+            # damage a re-read can never fix: raise it as corrupt so
+            # the error policy (not the retry loop) owns it.
+            if len(buf) == want and pos + len(buf) >= file_length:
+                raise ValueError(
+                    f"BGZF file ends mid-block at {pos} in {path}"
+                )
+            from disq_tpu.runtime.errors import TruncatedReadError
+
+            raise TruncatedReadError(
+                f"truncated BGZF block at {pos} in {path}"
+            )
         for rel, cs, us in entries:
             blocks.append(BgzfBlock(pos=pos + rel, csize=cs, usize=us))
         parts.append(buf[:consumed])
@@ -188,6 +203,85 @@ def _walk_blocks_collect(
     if not blocks:
         return [], b""
     return blocks, b"".join(parts)
+
+
+def walk_blocks_salvage(
+    fs: FileSystemWrapper, path: str, start: int, end: int, length: int,
+    ctx, owned_until: int,
+):
+    """One-block-at-a-time walk used only after the batched chain walk
+    (``_walk_blocks_collect``) raised on a malformed block header. Each
+    corrupt span is policy-handled via ``ctx`` (a
+    ``runtime.errors.ShardErrorContext`` — STRICT raises with the span's
+    coordinates) and the walk re-syncs at the next chain-validated block
+    start found by the guesser. Returns (blocks, data, gaps): ``data``
+    is contiguous from ``start`` (corrupt spans included, so block
+    offsets index it directly) and ``gaps`` lists the corrupt [lo, hi)
+    spans. Spans at or past ``owned_until`` are handled silently — their
+    owner counts them."""
+    from disq_tpu.bgzf.block import make_virtual_offset
+    from disq_tpu.runtime.errors import TruncatedReadError
+
+    blocks: List[BgzfBlock] = []
+    parts: List[bytes] = []
+    gaps: List[tuple] = []
+    guesser = BgzfBlockGuesser(fs, path)
+    pos = start
+    # This walk issues one small read per block: transient-fault retry
+    # must be per READ, not per walk — re-running the whole walk would
+    # never converge under a sustained fault rate. Each read is also
+    # length-checked: a short range read (flaky remote) must be retried
+    # as transient, never misclassified as at-rest corruption by the
+    # header parse below.
+    retry = ctx.retrier.call
+
+    def read_exact(p, n):
+        def attempt():
+            b = fs.read_range(path, p, n)
+            if len(b) < n:
+                raise TruncatedReadError(
+                    f"short read at {p} in {path}: {len(b)} < {n}")
+            return b
+        return retry(attempt, what="salvage_walk")
+
+    while pos < end and pos < length:
+        buf = read_exact(pos, min(BGZF_MAX_BLOCK_SIZE, length - pos))
+        try:
+            total = parse_block_header(buf, 0)
+            if total > len(buf):
+                raise ValueError(
+                    f"BGZF file ends mid-block at {pos} in {path}")
+            usize = struct.unpack_from("<I", buf, total - 4)[0]
+        except ValueError as e:
+            nxt = retry(guesser.guess_block_start, pos + 1,
+                        what="salvage_resync")
+            span_end = min(end, length)
+            if nxt is not None and nxt < span_end:
+                span_end = nxt
+            # Assemble the FULL corrupt span before quarantining it: the
+            # sidecar must hold the verbatim bytes, not just the first
+            # staged 64 KiB.
+            gap_raw = buf[: span_end - pos]
+            if len(gap_raw) < span_end - pos:
+                gap_raw += read_exact(
+                    pos + len(gap_raw), span_end - pos - len(gap_raw))
+            target = ctx.silent() if pos >= owned_until else ctx
+            target.handle_corrupt_block(
+                e, block_offset=pos,
+                raw=bytes(gap_raw),
+                virtual_offset=make_virtual_offset(pos, 0),
+                kind="BGZF block header",
+            )
+            parts.append(gap_raw)
+            gaps.append((pos, span_end))
+            if nxt is None or nxt >= min(end, length):
+                break
+            pos = span_end
+            continue
+        blocks.append(BgzfBlock(pos=pos, csize=total, usize=usize))
+        parts.append(buf[:total])
+        pos += total
+    return blocks, b"".join(parts), gaps
 
 
 def find_block_table(

@@ -37,6 +37,7 @@ import numpy as np
 from disq_tpu.bam.columnar import _NT16_CHARS, ReadBatch, SEQ_NT16
 from disq_tpu.cram.io import Cursor, write_itf8, write_itf8_array
 from disq_tpu.index.bai import bins_from_cigars
+from disq_tpu.runtime.errors import MissingReferenceError
 
 # Encoding codec ids (CRAM 3.0 §12)
 E_EXTERNAL = 1
@@ -1227,13 +1228,13 @@ def _decode_slice(
                 if gap > 0:
                     # reference-matching M stretch
                     if ref_fetch is None:
-                        raise ValueError(
+                        raise MissingReferenceError(
                             "reference required to decode this CRAM slice "
                             "(set reference_source_path)"
                         )
                     rb = ref_fetch(int(refid_l[i]), ref_pos, gap)
                     if rb is None or len(rb) < gap:
-                        raise ValueError(
+                        raise MissingReferenceError(
                             f"reference contig for refid {int(refid_l[i])} is "
                             f"missing or too short in the configured FASTA"
                         )
@@ -1267,13 +1268,13 @@ def _decode_slice(
             if tail > 0 and not (cf & CF_UNKNOWN_BASES):
                 if (flag & 0x4) == 0 and int(refid_l[i]) >= 0:
                     if ref_fetch is None:
-                        raise ValueError(
+                        raise MissingReferenceError(
                             "reference required to decode this CRAM slice "
                             "(set reference_source_path)"
                         )
                     rb = ref_fetch(int(refid_l[i]), ref_pos, tail)
                     if rb is None or len(rb) < tail:
-                        raise ValueError(
+                        raise MissingReferenceError(
                             f"reference contig for refid {int(refid_l[i])} is "
                             f"missing or too short in the configured FASTA"
                         )

@@ -73,28 +73,64 @@ class CramSource:
 
     def get_reads(self, path: str, traversal=None):
         from disq_tpu.api import ReadsDataset
+        from disq_tpu.runtime import ShardCounters, reduce_counters
+        from disq_tpu.runtime.errors import context_for_storage
 
         fs, path = resolve_path(path)
-        header = read_cram_header(fs, path)
+        ctx = context_for_storage(self._storage, path)
+        header = ctx.retrier.call(read_cram_header, fs, path, what="header")
         ref_fetch = self._ref_fetch(header)
-        containers = walk_container_offsets(fs, path)
+        containers = walk_container_offsets(
+            fs, path, retrier=ctx.retrier, ctx=ctx)
         data_containers = [
             (off, hdr) for off, hdr in containers[1:] if not hdr.is_eof
         ]
         if traversal is not None:
-            batch = self._read_with_traversal(
-                fs, path, header, ref_fetch, data_containers, traversal
+            # Index-driven reads retry transient faults whole-phase (the
+            # read is bounded by the queried intervals); corrupt
+            # containers inside the traversal always raise.
+            batch = ctx.retrier.call(
+                self._read_with_traversal, fs, path, header, ref_fetch,
+                data_containers, traversal, what="traversal",
             )
-            return ReadsDataset(header=header, reads=batch)
+            counters = reduce_counters([])
+            counters.retried_reads += ctx.retrier.retried
+            return ReadsDataset(header=header, reads=batch,
+                                counters=counters)
         batches = []
-        for s in compute_path_splits(fs, path, self.split_size):
+        shard_counters = []
+        for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
             owned = [
                 (off, hdr) for off, hdr in data_containers
                 if s.start <= off < s.end
             ]
+            shard_ctx = ctx.for_shard(i)
+            records = 0
             for off, hdr in owned:
-                batches.append(self._decode_at(fs, path, off, ref_fetch))
-        return ReadsDataset(header=header, reads=ReadBatch.concat(batches))
+                b = self._decode_container_safe(fs, path, off, ref_fetch,
+                                                shard_ctx)
+                if b is not None:
+                    records += b.count
+                    batches.append(b)
+            shard_counters.append(
+                ShardCounters(
+                    shard_id=i,
+                    records=records,
+                    blocks=len(owned),
+                    bytes_compressed=sum(h.length for _, h in owned),
+                    skipped_blocks=shard_ctx.skipped_blocks,
+                    quarantined_blocks=shard_ctx.quarantined_blocks,
+                    retried_reads=shard_ctx.retrier.retried,
+                )
+            )
+        counters = reduce_counters(shard_counters)
+        # Walk/header-phase events happened on the top-level context,
+        # outside any shard's counters.
+        counters.retried_reads += ctx.retrier.retried
+        counters.skipped_blocks += ctx.skipped_blocks
+        counters.quarantined_blocks += ctx.quarantined_blocks
+        return ReadsDataset(header=header, reads=ReadBatch.concat(batches),
+                            counters=counters)
 
     # -- internals ----------------------------------------------------------
 
@@ -104,6 +140,46 @@ class CramSource:
         )
         blocks = fs.read_range(path, offset + hdr_size, hdr.length)
         return decode_container_records(blocks, ref_fetch)
+
+    def _decode_container_safe(
+        self, fs, path: str, offset: int, ref_fetch, shard_ctx
+    ) -> Optional[ReadBatch]:
+        """One container decode under the shard's error policy: transient
+        faults retry; configuration errors (missing reference) always
+        propagate; anything else is a corrupt container — strict raises
+        with coordinates, skip drops it, quarantine copies the whole
+        container (header + payload) to the sidecar."""
+        from disq_tpu.runtime.errors import (
+            ErrorPolicy,
+            MissingReferenceError,
+            is_transient,
+        )
+
+        try:
+            return shard_ctx.retrier.call(
+                self._decode_at, fs, path, offset, ref_fetch,
+                what=f"container@{offset}",
+            )
+        except MissingReferenceError:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below
+            if is_transient(e):
+                raise
+            raw = b""
+            if shard_ctx.policy is ErrorPolicy.QUARANTINE:
+                # Only quarantine uses the bytes — don't re-fetch a
+                # multi-MB container just to discard it under skip.
+                try:
+                    hdr, hdr_size = read_container_header_at(
+                        fs, path, offset, fs.get_file_length(path)
+                    )
+                    raw = fs.read_range(path, offset, hdr_size + hdr.length)
+                except Exception:  # noqa: BLE001 — forensics best-effort
+                    pass
+            shard_ctx.handle_corrupt_block(
+                e, block_offset=offset, raw=raw, kind="CRAM container"
+            )
+            return None
 
     def _read_with_traversal(
         self, fs, path, header, ref_fetch, data_containers, traversal

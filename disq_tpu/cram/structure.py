@@ -240,16 +240,56 @@ def read_container_header_at(
             want *= 4
 
 
-def walk_container_offsets(fs, path: str) -> List[Tuple[int, ContainerHeader]]:
+def walk_container_offsets(
+    fs, path: str, retrier=None, ctx=None
+) -> List[Tuple[int, ContainerHeader]]:
     """Enumerate (offset, header) of every container by reading headers
     and skipping payloads — the ``CramContainerHeaderIterator`` walk the
-    reference runs on the driver (SURVEY.md §3.5). Seek-dominated."""
+    reference runs on the driver (SURVEY.md §3.5). Seek-dominated.
+
+    ``retrier`` (a ``runtime.errors.ShardRetrier``) makes each header
+    read individually retryable: one read per container means a
+    whole-walk retry would never converge under a sustained transient
+    fault rate.
+
+    ``ctx`` (a ``ShardErrorContext``) governs a *corrupt* container
+    header: STRICT raises with the container's coordinates; skip and
+    quarantine count one corrupt unit and stop the walk there — CRAM
+    has no BGZF-style chain re-sync, so the containers beyond a broken
+    length field are unreachable and their loss is bounded, explicit,
+    and counted."""
+    from disq_tpu.runtime.errors import is_transient
+
     length = fs.get_file_length(path)
     out: List[Tuple[int, ContainerHeader]] = []
     # File definition is 26 bytes.
     pos = 26
     while pos < length:
-        hdr, hdr_size = read_container_header_at(fs, path, pos, length)
+        try:
+            if retrier is not None:
+                hdr, hdr_size = retrier.call(
+                    read_container_header_at, fs, path, pos, length,
+                    what="container_header",
+                )
+            else:
+                hdr, hdr_size = read_container_header_at(
+                    fs, path, pos, length)
+        except Exception as e:  # noqa: BLE001 — classified below
+            if ctx is None or is_transient(e):
+                raise
+            ctx.handle_corrupt_block(
+                e, block_offset=pos, kind="CRAM container header")
+            break
+        if hdr.length < 0:
+            # A garbage length would walk pos backwards (or loop):
+            # classify as corrupt rather than spin.
+            err = ValueError(
+                f"container at {pos} claims negative length {hdr.length}")
+            if ctx is None:
+                raise err
+            ctx.handle_corrupt_block(
+                err, block_offset=pos, kind="CRAM container header")
+            break
         out.append((pos, hdr))
         pos += hdr_size + hdr.length
         if hdr.is_eof:

@@ -3,7 +3,12 @@ from disq_tpu.fsw.filesystem import (  # noqa: F401
     PosixFileSystemWrapper,
     MemoryFileSystemWrapper,
     get_filesystem,
+    register_filesystem,
     resolve_path,
     PathSplit,
     compute_path_splits,
+)
+from disq_tpu.fsw.faultfs import (  # noqa: F401
+    FaultInjectingFileSystemWrapper,
+    FaultSpec,
 )

@@ -21,6 +21,7 @@ from __future__ import annotations
 import io
 import os
 import shutil
+import uuid
 from dataclasses import dataclass
 from typing import BinaryIO, Dict, List, Sequence, Tuple
 
@@ -112,6 +113,67 @@ class FileSystemWrapper:
         raise NotImplementedError
 
 
+class _AtomicWriteFile(io.FileIO):
+    """Write stream that stages to a hidden tmp sibling and commits with
+    ``os.replace`` on close — a writer killed mid-write never leaves a
+    truncated file at the final path for a later ``exists()`` check to
+    mistake for a complete one. Exiting a ``with`` block on an exception
+    aborts instead of committing (the tmp is deleted), for the same
+    reason. The tmp name is dot-prefixed so ``list_directory``'s
+    hidden-file filter never surfaces orphans."""
+
+    def __init__(self, tmp_path: str, final_path: str) -> None:
+        super().__init__(tmp_path, "w")
+        self._tmp_path = tmp_path
+        self._final_path = final_path
+        self._aborted = False
+
+    def write(self, b) -> int:
+        # io.FileIO.write is a single os.write, which may be short
+        # (notably capped near 2 GiB on Linux). The open(path, "wb")
+        # this replaced returned a BufferedWriter that looped; callers
+        # (write_all, copyfileobj, the sinks) discard the return value,
+        # so a short write here would be silently *committed* as a
+        # complete file by the atomic rename. Loop until done.
+        mv = memoryview(b).cast("B")
+        done = 0
+        while done < len(mv):
+            n = super().write(mv[done:])
+            if not n:
+                raise IOError(
+                    f"short write to {self._tmp_path!r} at byte {done}")
+            done += n
+        return done
+
+    def abort(self) -> None:
+        """Discard the staged bytes: close without publishing."""
+        self._aborted = True
+        self.close()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._aborted = self._aborted or exc_type is not None
+        super().__exit__(exc_type, exc, tb)
+
+    def __del__(self) -> None:
+        # A writer garbage-collected without close()/abort() was
+        # abandoned mid-write: discard, never publish a partial file.
+        self._aborted = True
+        super().__del__()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        super().close()
+        if self._aborted:
+            try:
+                os.unlink(self._tmp_path)
+            except (FileNotFoundError, TypeError):
+                # TypeError: os torn down during interpreter shutdown
+                pass
+        else:
+            os.replace(self._tmp_path, self._final_path)
+
+
 class PosixFileSystemWrapper(FileSystemWrapper):
     """Local-filesystem impl (reference: ``impl/file/NioFileSystemWrapper.java``)."""
 
@@ -125,9 +187,18 @@ class PosixFileSystemWrapper(FileSystemWrapper):
         return open(path, "rb")
 
     def create(self, path: str) -> BinaryIO:
-        parent = os.path.dirname(os.path.abspath(path))
+        path = os.path.abspath(path)
+        parent = os.path.dirname(path)
         os.makedirs(parent, exist_ok=True)
-        return open(path, "wb")
+        # pid alone is not unique enough: two threads staging the same
+        # destination would truncate each other's tmp. uuid gives each
+        # writer its own staging file; last close() wins the replace.
+        tmp = os.path.join(
+            parent,
+            f".{os.path.basename(path)}.tmp-{os.getpid()}-"
+            f"{uuid.uuid4().hex[:8]}",
+        )
+        return _AtomicWriteFile(tmp, path)
 
     def list_directory(self, path: str) -> List[str]:
         return sorted(

@@ -81,10 +81,24 @@ class HttpFileSystemWrapper(FileSystemWrapper):
         # caller holds self._lock
         self._cache[key] = value
         self._cache.move_to_end(key)
-        while len(self._cache) > self.max_cached_blocks:
-            old_key, old = next(iter(self._cache.items()))
+        if len(self._cache) <= self.max_cached_blocks:
+            return
+        # Evict from the LRU head, *skipping* (never dropping) in-flight
+        # prefetches: an in-flight Future at the head must not shield
+        # completed blocks behind it from eviction, or the cache grows
+        # past max_cached_blocks for as long as fetches stall.
+        for old_key in list(self._cache):
+            if len(self._cache) <= self.max_cached_blocks:
+                break
+            if old_key == key:
+                # With everything older in flight, the walk reaches the
+                # entry just inserted — evicting it would refetch the
+                # block on the very next read. Let the cache run over by
+                # the in-flight count instead.
+                continue
+            old = self._cache[old_key]
             if isinstance(old, Future) and not old.done():
-                break  # never drop an in-flight prefetch
+                continue  # never drop an in-flight prefetch
             self._cache.pop(old_key)
 
     # -- plumbing ----------------------------------------------------------
@@ -94,59 +108,57 @@ class HttpFileSystemWrapper(FileSystemWrapper):
     _TIMEOUT_S = 60.0     # per-request; a stalled connection must fail
                           # into the retry loop, not hang a worker
 
-    def _fetch(self, url: str, start: int, end_incl: int) -> bytes:
-        """One ranged GET with bounded retry on transient failures —
-        the Hadoop-FS retry role. Client errors (4xx) raise
-        immediately; 5xx, network errors, truncated bodies and stalls
-        back off and retry. A server ignoring Range (200 with the whole
-        object) is sliced, accounted at its REAL transfer size, and
-        seeds the block cache so a scan doesn't re-download the object
-        per block."""
-        import http.client
-        import time
+    def _retrying(self, op):
+        """Run ``op()`` under the read stack's shared transient
+        classification and bounded backoff
+        (``runtime.errors.ShardRetrier`` / ``is_transient``) — one
+        definition of "transient", shared by ranged GETs and HEADs.
+        Client errors (4xx) raise immediately; 5xx, network errors and
+        stalls back off and retry; the last transient error surfaces
+        once the budget is spent."""
+        from disq_tpu.runtime.errors import ShardRetrier
 
-        last = None
-        for attempt in range(self._RETRIES + 1):
-            if attempt:
+        retrier = ShardRetrier(self._RETRIES, self._BACKOFF_S)
+        try:
+            return retrier.call(op, what="http")
+        finally:
+            if retrier.retried:
                 with self._lock:
-                    self.stats.retries += 1
-                time.sleep(self._BACKOFF_S * (2 ** (attempt - 1)))
-            try:
-                req = urllib.request.Request(
-                    url, headers={"Range": f"bytes={start}-{end_incl}"})
-                with urllib.request.urlopen(
-                        req, timeout=self._TIMEOUT_S) as resp:
-                    data = resp.read()
-                    full = data if resp.status == 200 else None
-            except urllib.error.HTTPError as e:
-                if e.code < 500:
-                    raise
-                last = e
-                continue
-            except (urllib.error.URLError, http.client.HTTPException,
-                    OSError, TimeoutError) as e:
-                last = e
-                continue
-            if full is not None:
-                data = full[start: end_incl + 1]
-                bs = self.block_size
-                want = start // bs
-                with self._lock:
-                    self.stats.range_requests += 1
-                    self.stats.bytes_fetched += len(full)
-                    for bi in range((len(full) + bs - 1) // bs):
-                        if bi != want:
-                            self._cache_put(
-                                (url, bi), full[bi * bs: (bi + 1) * bs])
-                    # the requested block last, so LRU keeps it
-                    self._cache_put(
-                        (url, want), full[want * bs: (want + 1) * bs])
-            else:
-                with self._lock:
-                    self.stats.range_requests += 1
-                    self.stats.bytes_fetched += len(data)
-            return data
-        raise last
+                    self.stats.retries += retrier.retried
+
+    def _fetch(self, url: str, start: int, end_incl: int) -> bytes:
+        """One ranged GET via ``_retrying``. A server ignoring Range
+        (200 with the whole object) is sliced, accounted at its REAL
+        transfer size, and seeds the block cache so a scan doesn't
+        re-download the object per block."""
+        def ranged_get():
+            req = urllib.request.Request(
+                url, headers={"Range": f"bytes={start}-{end_incl}"})
+            with urllib.request.urlopen(
+                    req, timeout=self._TIMEOUT_S) as resp:
+                body = resp.read()
+                return body, (body if resp.status == 200 else None)
+
+        data, full = self._retrying(ranged_get)
+        if full is not None:
+            data = full[start: end_incl + 1]
+            bs = self.block_size
+            want = start // bs
+            with self._lock:
+                self.stats.range_requests += 1
+                self.stats.bytes_fetched += len(full)
+                for bi in range((len(full) + bs - 1) // bs):
+                    if bi != want:
+                        self._cache_put(
+                            (url, bi), full[bi * bs: (bi + 1) * bs])
+                # the requested block last, so LRU keeps it
+                self._cache_put(
+                    (url, want), full[want * bs: (want + 1) * bs])
+        else:
+            with self._lock:
+                self.stats.range_requests += 1
+                self.stats.bytes_fetched += len(data)
+        return data
 
     def _block(self, url: str, idx: int, length: int) -> bytes:
         key = (url, idx)
@@ -193,24 +205,32 @@ class HttpFileSystemWrapper(FileSystemWrapper):
     # -- FileSystemWrapper interface --------------------------------------
 
     def exists(self, path: str) -> bool:
+        """HEAD through the same ``_retrying`` timeout + transient-retry
+        discipline as ``_fetch``: a stalled or 5xx HEAD must not hang a
+        worker or misreport a live object as missing."""
         url = rewrite_remote_uri(path)
         req = urllib.request.Request(url, method="HEAD")
+
+        def head():
+            with urllib.request.urlopen(
+                    req, timeout=self._TIMEOUT_S) as resp:
+                return resp.headers.get("Content-Length")
+
         try:
-            with urllib.request.urlopen(req) as resp:
-                clen = resp.headers.get("Content-Length")
-                if clen is None:
-                    # a length-less HEAD would make every read clamp to
-                    # b"" — fail loudly instead
-                    raise IOError(
-                        f"HEAD {url} returned no Content-Length; "
-                        "range staging needs a sized object")
-                self._lengths[url] = int(clen)
-            return True
+            clen = self._retrying(head)
         except urllib.error.HTTPError as e:
             # S3 answers 403 for missing keys without list permission
             if e.code in (403, 404):
                 return False
             raise
+        if clen is None:
+            # a length-less HEAD would make every read clamp to b"" — a
+            # deterministic protocol defect, not transient: fail loudly
+            raise IOError(
+                f"HEAD {url} returned no Content-Length; "
+                "range staging needs a sized object")
+        self._lengths[url] = int(clen)
+        return True
 
     def get_file_length(self, path: str) -> int:
         url = rewrite_remote_uri(path)

@@ -6,7 +6,13 @@ protocol. JAX has no task retry, so the equivalents here are:
 
 - ``manifest`` — a deterministic, restartable *stage manifest* on disk:
   which shard ranges have been decoded/sorted/written, with shard-level
-  re-execution on restart and the same temp-dir commit protocol.
+  re-execution on restart and the same temp-dir commit protocol; plus
+  the ``QuarantineManifest`` sidecar ledger for corrupt blocks.
+- ``errors`` — the read-path error policy: ``ShardRetrier`` (bounded
+  backoff retry of transient faults), ``ErrorPolicy``
+  (strict/skip/quarantine dispatch of corrupt blocks), and
+  ``CorruptBlockError`` with full (path, shard, block, voffset)
+  coordinates.
 - ``counters`` — per-shard counters (records, blocks, bytes,
   compression ratio) returned per shard and reduced.
 - ``tracing`` — phase wrappers around ``jax.profiler`` traces plus
@@ -22,7 +28,21 @@ from disq_tpu.runtime.counters import (  # noqa: F401
     ShardCounters,
     reduce_counters,
 )
-from disq_tpu.runtime.manifest import StageManifest  # noqa: F401
+from disq_tpu.runtime.errors import (  # noqa: F401
+    CorruptBlockError,
+    DisqOptions,
+    ErrorPolicy,
+    ShardErrorContext,
+    ShardRetrier,
+    TransientIOError,
+    TruncatedReadError,
+    context_for_storage,
+    is_transient,
+)
+from disq_tpu.runtime.manifest import (  # noqa: F401
+    QuarantineManifest,
+    StageManifest,
+)
 from disq_tpu.runtime.tracing import trace_phase, phase_report  # noqa: F401
 from disq_tpu.runtime.debug import (  # noqa: F401
     debug_enabled,

@@ -22,6 +22,12 @@ class ShardCounters:
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
     wall_seconds: float = 0.0
+    # Error-policy observability (runtime/errors.py): how many corrupt
+    # blocks this shard dropped / copied aside, and how many transient
+    # read failures were absorbed by retry.
+    skipped_blocks: int = 0
+    quarantined_blocks: int = 0
+    retried_reads: int = 0
 
     def as_dict(self) -> Dict[str, float]:
         return {f.name: getattr(self, f.name) for f in fields(self)}
@@ -35,6 +41,9 @@ class PipelineCounters:
     bytes_compressed: int = 0
     bytes_uncompressed: int = 0
     wall_seconds: float = 0.0
+    skipped_blocks: int = 0
+    quarantined_blocks: int = 0
+    retried_reads: int = 0
 
     @property
     def compression_ratio(self) -> float:
@@ -49,12 +58,12 @@ class PipelineCounters:
 
 
 def reduce_counters(shard_counters: Iterable[ShardCounters]) -> PipelineCounters:
+    # Field-wise sum over every ShardCounters field except shard_id, so a
+    # counter added to both dataclasses folds without touching this code.
+    summed = [f.name for f in fields(ShardCounters) if f.name != "shard_id"]
     total = PipelineCounters()
     for c in shard_counters:
         total.shards += 1
-        total.records += c.records
-        total.blocks += c.blocks
-        total.bytes_compressed += c.bytes_compressed
-        total.bytes_uncompressed += c.bytes_uncompressed
-        total.wall_seconds += c.wall_seconds
+        for name in summed:
+            setattr(total, name, getattr(total, name) + getattr(c, name))
     return total

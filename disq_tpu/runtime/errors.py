@@ -1,0 +1,364 @@
+"""Shard-level error policy — retry / skip / quarantine (SURVEY.md §5).
+
+The reference inherits fault tolerance from Spark: a task that dies on a
+flaky range-read is simply re-executed, and a corrupt input kills the
+job with a stack trace pointing at nothing. disq_tpu replaces both with
+explicit, observable machinery:
+
+- **Transient faults** (network blips, stalled connections, truncated
+  range reads) are retried per shard with bounded exponential backoff
+  (``ShardRetrier`` — the Spark-task-retry analogue). Every retry is
+  counted (``ShardCounters.retried_reads``) and traced
+  (``trace_phase("retry.<what>")``).
+- **Corrupt data** (failed CRC, bad DEFLATE bits, impossible record
+  framing) is *not* retried — re-reading corrupt bytes yields the same
+  corrupt bytes. It is governed by an ``ErrorPolicy``:
+
+  - ``STRICT`` (default): raise ``CorruptBlockError`` carrying the full
+    coordinates (path, shard, compressed block offset, virtual offset).
+  - ``SKIP``: drop the corrupt block, count it
+    (``ShardCounters.skipped_blocks``), decode everything else.
+  - ``QUARANTINE``: as SKIP, but additionally copy the corrupt
+    compressed bytes to a sidecar file recorded in a
+    ``QuarantineManifest`` (``runtime/manifest.py``) for offline
+    forensics / re-processing.
+
+The classification boundary is ``is_transient``: OSError-family errors
+(minus the definitive ones like ``FileNotFoundError``) and truncated
+reads are transient; ``ValueError``-family codec errors are corrupt.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ErrorPolicy(enum.Enum):
+    """What to do with a shard's corrupt (non-transient) block."""
+
+    STRICT = "strict"
+    SKIP = "skip"
+    QUARANTINE = "quarantine"
+
+    @classmethod
+    def coerce(cls, value: "ErrorPolicy | str") -> "ErrorPolicy":
+        if isinstance(value, cls):
+            return value
+        try:
+            return cls(str(value).lower())
+        except ValueError:
+            raise ValueError(
+                f"unknown error policy {value!r}; expected one of "
+                f"{[p.value for p in cls]}"
+            ) from None
+
+
+@dataclass(frozen=True)
+class DisqOptions:
+    """Read-path runtime knobs, attached to the storage builders
+    (``ReadsStorage.error_policy(...)`` / ``VariantsStorage``).
+
+    ``quarantine_dir`` defaults to ``<input path> + ".quarantine"`` on
+    the local filesystem; remote (read-only) inputs must set it
+    explicitly.
+    """
+
+    error_policy: ErrorPolicy = ErrorPolicy.STRICT
+    max_retries: int = 3
+    retry_backoff_s: float = 0.05
+    quarantine_dir: Optional[str] = None
+
+    def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
+        return replace(self, error_policy=ErrorPolicy.coerce(policy))
+
+
+class CorruptBlockError(ValueError):
+    """A compressed block failed decode *with certainty* (CRC mismatch,
+    invalid DEFLATE bits, impossible container framing) — carrying the
+    coordinates every layer above needs to act on it."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        path: str = "",
+        shard_id: int = -1,
+        block_offset: int = -1,
+        virtual_offset: Optional[int] = None,
+    ) -> None:
+        detail = (
+            f"{message} [path={path!r} shard={shard_id} "
+            f"block_offset={block_offset}"
+            + (f" voffset={virtual_offset:#x}" if virtual_offset is not None else "")
+            + "]"
+        )
+        super().__init__(detail)
+        self.path = path
+        self.shard_id = shard_id
+        self.block_offset = block_offset
+        self.virtual_offset = virtual_offset
+
+
+class TransientIOError(IOError):
+    """Marker for errors known to be transient (used by the fault
+    injector and by wrappers that can prove transience)."""
+
+
+class MissingReferenceError(ValueError):
+    """Reference FASTA absent/wrong for reference-compressed CRAM — a
+    *configuration* error: never retried, and never treated as data
+    corruption by skip/quarantine (silently dropping every container
+    because the user forgot ``reference_source_path`` would be a
+    catastrophe, not fault tolerance)."""
+
+
+class TruncatedReadError(OSError, ValueError):
+    """A range read returned fewer bytes than the on-disk structure
+    requires. Subclasses ``OSError`` (it is an I/O symptom — a flaky
+    remote can truncate a body, so it is *retryable*) and ``ValueError``
+    (compat: callers of the block walk historically catch ValueError)."""
+
+
+# OSError subclasses that are definitive, not worth retrying.
+_PERMANENT_OS_ERRORS = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+    FileExistsError,
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient (retryable) vs. permanent/corrupt classification."""
+    if isinstance(exc, TransientIOError):
+        return True
+    if isinstance(exc, CorruptBlockError):
+        return False
+    if isinstance(exc, _PERMANENT_OS_ERRORS):
+        return False
+    if isinstance(exc, (TimeoutError, ConnectionError, TruncatedReadError)):
+        return True
+    try:
+        import urllib.error
+
+        if isinstance(exc, urllib.error.HTTPError):
+            return exc.code >= 500
+        if isinstance(exc, urllib.error.URLError):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        import http.client
+
+        # IncompleteRead / RemoteDisconnected and friends: wire-level
+        # symptoms a re-request can fix.
+        if isinstance(exc, http.client.HTTPException):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    return isinstance(exc, OSError)
+
+
+class ShardRetrier:
+    """Bounded retry with exponential backoff for transient faults —
+    the analogue of Spark task retry, scoped to one shard's work.
+
+    ``call(fn, ...)`` runs ``fn`` up to ``1 + max_retries`` times,
+    retrying only when ``is_transient`` says the failure is worth it.
+    Retries are counted in ``.retried`` and traced as
+    ``retry.<what>`` phases so a flaky store is visible in
+    ``phase_report()``.
+    """
+
+    def __init__(
+        self,
+        max_retries: int = 3,
+        backoff_s: float = 0.05,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self._sleep = sleep
+        self.retried = 0
+
+    def call(self, fn: Callable[..., T], *args: Any,
+             what: str = "read", **kwargs: Any) -> T:
+        from disq_tpu.runtime.tracing import trace_phase
+
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not is_transient(e) or attempt >= self.max_retries:
+                    raise
+                attempt += 1
+                self.retried += 1
+                with trace_phase(f"retry.{what}"):
+                    self._sleep(self.backoff_s * (2 ** (attempt - 1)))
+
+
+@dataclass
+class ShardErrorContext:
+    """Per-shard bundle: the policy, the retrier, and the corrupt-block
+    bookkeeping, threaded through a source's shard loop."""
+
+    policy: ErrorPolicy
+    path: str
+    shard_id: int = -1
+    retrier: ShardRetrier = field(default_factory=ShardRetrier)
+    quarantine: Optional["QuarantineManifest"] = None  # noqa: F821
+    quarantine_dir: Optional[str] = None
+    skipped_blocks: int = 0
+    quarantined_blocks: int = 0
+
+    def for_shard(self, shard_id: int) -> "ShardErrorContext":
+        """A fresh per-shard view (own retrier + counters) sharing the
+        policy and the quarantine sink."""
+        ctx = ShardErrorContext(
+            policy=self.policy,
+            path=self.path,
+            shard_id=shard_id,
+            retrier=ShardRetrier(
+                self.retrier.max_retries, self.retrier.backoff_s,
+                self.retrier._sleep,
+            ),
+            quarantine=self.quarantine,
+            quarantine_dir=self.quarantine_dir,
+        )
+        ctx._parent = self  # type: ignore[attr-defined]
+        return ctx
+
+    # -- corrupt-block dispatch -------------------------------------------
+
+    def handle_corrupt_block(
+        self,
+        error: BaseException,
+        *,
+        block_offset: int,
+        raw: bytes = b"",
+        virtual_offset: Optional[int] = None,
+        kind: str = "block",
+    ) -> None:
+        """Apply the policy to one corrupt block. STRICT raises a
+        ``CorruptBlockError`` with full coordinates; SKIP counts;
+        QUARANTINE additionally copies ``raw`` to the sidecar."""
+        if self.policy is ErrorPolicy.STRICT:
+            raise CorruptBlockError(
+                f"corrupt {kind}: {error}",
+                path=self.path,
+                shard_id=self.shard_id,
+                block_offset=block_offset,
+                virtual_offset=virtual_offset,
+            ) from error
+        if self.policy is ErrorPolicy.QUARANTINE:
+            self._quarantine_sink().quarantine(
+                self.path,
+                block_offset,
+                raw,
+                shard_id=self.shard_id,
+                virtual_offset=virtual_offset,
+                error=str(error),
+                kind=kind,
+            )
+            self.quarantined_blocks += 1
+        else:
+            self.skipped_blocks += 1
+
+    def silent(self) -> "ShardErrorContext":
+        """A non-counting view for blocks this shard reads but does NOT
+        own (split-boundary straddle blocks, boundary-guess windows,
+        straddling-line extensions): the owning shard does the counting
+        and quarantining, so handling them here would double-book one
+        corrupt block across two shards. STRICT still raises — failing
+        at first sight is identical to failing when the owner decodes."""
+        if self.policy is ErrorPolicy.STRICT:
+            return self
+        return ShardErrorContext(
+            policy=ErrorPolicy.SKIP, path=self.path, shard_id=self.shard_id
+        )
+
+    def _quarantine_sink(self) -> "QuarantineManifest":  # noqa: F821
+        if self.quarantine is None:
+            from disq_tpu.runtime.manifest import QuarantineManifest
+
+            parent = getattr(self, "_parent", None)
+            if parent is not None and parent.quarantine is not None:
+                self.quarantine = parent.quarantine
+            else:
+                base = self.quarantine_dir
+                if base is None:
+                    if "://" in self.path:
+                        raise ValueError(
+                            "ErrorPolicy.QUARANTINE on remote input "
+                            f"{self.path!r} requires an explicit "
+                            "DisqOptions.quarantine_dir — the default "
+                            "sidecar location <input>.quarantine only "
+                            "exists for local files"
+                        )
+                    base = self.path + ".quarantine"
+                self.quarantine = QuarantineManifest(base)
+                if parent is not None:
+                    parent.quarantine = self.quarantine
+        return self.quarantine
+
+
+def context_for_storage(storage, path: str) -> ShardErrorContext:
+    """Build the read-path error context from a storage builder's
+    ``DisqOptions`` (absent/None ⇒ defaults: STRICT, 3 retries)."""
+    opts = getattr(storage, "_options", None) or DisqOptions()
+    return ShardErrorContext(
+        policy=ErrorPolicy.coerce(opts.error_policy),
+        path=path,
+        retrier=ShardRetrier(opts.max_retries, opts.retry_backoff_s),
+        quarantine_dir=opts.quarantine_dir,
+    )
+
+
+# -- BGZF salvage ----------------------------------------------------------
+
+
+def inflate_blocks_salvage(data, blocks, base: int, ctx: ShardErrorContext,
+                           owned_until: Optional[int] = None):
+    """Per-block inflate applying ``ctx``'s policy: returns a list of
+    per-block payloads with ``None`` holes where a corrupt block was
+    skipped/quarantined (STRICT raises on the first corrupt block).
+
+    Blocks at file offset >= ``owned_until`` (the boundary straddle this
+    shard reads but its successor owns) are salvaged with the silent,
+    non-counting view of ``ctx`` so one corrupt block is never booked by
+    two shards.
+
+    This is the slow path behind the batched ``inflate_blocks`` — used
+    only once a batch inflate has already failed, so the common fault-free
+    decode pays nothing.
+    """
+    from disq_tpu.bgzf.block import make_virtual_offset
+    from disq_tpu.bgzf.codec import inflate_block
+
+    silent = ctx.silent()
+    payloads = []
+    for b in blocks:
+        off = b.pos - base
+        try:
+            payloads.append(inflate_block(data, off))
+        except ValueError as e:
+            target = (
+                silent if owned_until is not None and b.pos >= owned_until
+                else ctx
+            )
+            target.handle_corrupt_block(
+                e,
+                block_offset=b.pos,
+                raw=bytes(data[off: off + b.csize]),
+                virtual_offset=make_virtual_offset(b.pos, 0),
+                kind="BGZF block",
+            )
+            payloads.append(None)
+    return payloads

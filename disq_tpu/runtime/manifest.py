@@ -15,10 +15,11 @@ shard completion, so a crash at any point leaves a consistent file.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 FORMAT_VERSION = 1
 
@@ -132,3 +133,121 @@ class StageManifest:
         directory is cleaned separately by the caller)."""
         if os.path.exists(self.path):
             os.unlink(self.path)
+
+
+QUARANTINE_FORMAT_VERSION = 1
+
+
+class QuarantineManifest:
+    """Sidecar ledger for corrupt blocks set aside under
+    ``ErrorPolicy.QUARANTINE`` (``runtime/errors.py``).
+
+    Layout under ``base_dir`` (default ``<input>.quarantine``):
+
+    - ``MANIFEST.jsonl`` — line 1 is ``{"version": 1}``; every further
+      line is one quarantined-block record ``{"path", "shard_id",
+      "block_offset", "virtual_offset", "kind", "error", "sidecar",
+      "length"}``, appended as the block is set aside. Append-only
+      keeps the ledger O(1) per corrupt block — quarantine exists
+      precisely for heavily damaged inputs, where rewriting a JSON
+      document per block would be quadratic. A crash can tear at most
+      the final line, which the loader ignores; re-quarantining the
+      same ``(path, block_offset)`` appends a newer record and readers
+      take the last one (idempotent under shard re-execution / retry).
+    - ``block-<pathtag>-<offset>.bin`` — the verbatim corrupt
+      *compressed* bytes, for offline forensics or re-decode with a
+      repaired codec. ``pathtag`` is a digest of the input path, so
+      multiple inputs sharing one ``quarantine_dir`` never collide.
+    """
+
+    MANIFEST_NAME = "MANIFEST.jsonl"
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        self.path = os.path.join(base_dir, self.MANIFEST_NAME)
+        self._entries: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._header_ok = False
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, "r") as f:
+                    lines = f.read().splitlines()
+            except (OSError, UnicodeDecodeError):
+                lines = []
+            for i, line in enumerate(lines):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    if i == 0:
+                        break  # headerless/torn ledger: don't trust it
+                    continue  # torn tail line from a crash
+                if i == 0:
+                    if (not isinstance(rec, dict)
+                            or rec.get("version")
+                            != QUARANTINE_FORMAT_VERSION):
+                        break  # foreign ledger: don't merge into it
+                    self._header_ok = True
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                key = (rec.get("path", ""), rec.get("block_offset", -1))
+                self._entries[key] = rec
+
+    @property
+    def entries(self) -> List[Dict[str, Any]]:
+        return list(self._entries.values())
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        if (not self._header_ok and os.path.exists(self.path)
+                and os.path.getsize(self.path) > 0):
+            # A headerless (torn at creation) or foreign-version ledger:
+            # appending v1 records into it would corrupt it for its own
+            # readers. Set it aside and start fresh.
+            os.replace(self.path, self.path + ".bak")
+        with open(self.path, "a") as f:
+            if not self._header_ok:
+                f.write(json.dumps(
+                    {"version": QUARANTINE_FORMAT_VERSION}) + "\n")
+                self._header_ok = True
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def quarantine(
+        self,
+        path: str,
+        block_offset: int,
+        raw: bytes,
+        *,
+        shard_id: int = -1,
+        virtual_offset: Optional[int] = None,
+        error: str = "",
+        kind: str = "block",
+    ) -> str:
+        """Copy one corrupt block aside; returns the sidecar path."""
+        os.makedirs(self.base_dir, exist_ok=True)
+        tag = hashlib.sha1(path.encode()).hexdigest()[:8]
+        sidecar = os.path.join(
+            self.base_dir, f"block-{tag}-{block_offset}.bin")
+        # Atomic sidecar commit: a crash between sidecar write and
+        # ledger append must not leave a truncated sidecar that a
+        # recorded entry later points at.
+        fd, tmp = tempfile.mkstemp(dir=self.base_dir, prefix=".block-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, sidecar)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        entry = {
+            "path": path,
+            "shard_id": shard_id,
+            "block_offset": block_offset,
+            "virtual_offset": virtual_offset,
+            "kind": kind,
+            "error": error,
+            "sidecar": sidecar,
+            "length": len(raw),
+        }
+        self._entries[(path, block_offset)] = entry
+        self._append(entry)
+        return sidecar

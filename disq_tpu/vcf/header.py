@@ -100,7 +100,10 @@ def read_vcf_header(fs: FileSystemWrapper, path: str) -> VcfHeader:
     lines: List[str] = []
     buf = b""
     while True:
-        chunk = stream.read(1 << 16)
+        # Modest chunks: reading far past the last header line would
+        # needlessly decode body blocks — and turn a corrupt body block
+        # (the error policy's job, per split) into a header failure.
+        chunk = stream.read(4096)
         if not chunk:
             break
         buf += chunk

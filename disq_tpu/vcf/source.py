@@ -32,6 +32,7 @@ from disq_tpu.vcf.header import read_vcf_header, sniff_compression
 class VcfSource:
     def __init__(self, storage=None):
         self._storage = storage
+        self._last_counters = []
 
     @property
     def split_size(self) -> int:
@@ -41,35 +42,69 @@ class VcfSource:
 
     def get_variants(self, path: str, intervals=None):
         from disq_tpu.api import VariantsDataset
+        from disq_tpu.runtime import reduce_counters
+        from disq_tpu.runtime.errors import context_for_storage
 
         fs, path = resolve_path(path)
-        header = read_vcf_header(fs, path)
-        kind = sniff_compression(fs.read_range(path, 0, 18))
+        ctx = context_for_storage(self._storage, path)
+        self._last_counters = []
+        header = ctx.retrier.call(read_vcf_header, fs, path, what="header")
+        kind = sniff_compression(
+            ctx.retrier.call(fs.read_range, path, 0, 18, what="sniff"))
 
         if intervals is not None and kind == "bgzf" and fs.exists(path + ".tbi"):
-            batch = self._read_with_tabix(fs, path, header, intervals)
+            batch = ctx.retrier.call(
+                self._read_with_tabix, fs, path, header, intervals,
+                what="tabix")
         elif kind == "plain":
-            batch = self._read_plain(fs, path, header)
+            batch = self._read_plain(fs, path, header, ctx)
         elif kind == "gzip":
-            batch = self._read_whole_gzip(fs, path, header)
+            batch = ctx.retrier.call(
+                self._read_whole_gzip, fs, path, header, what="gzip")
         else:
-            batch = self._read_bgzf(fs, path, header)
+            batch = self._read_bgzf(fs, path, header, ctx)
         if intervals is not None:
             batch = batch.filter(self._overlap_mask(batch, intervals))
         header = header.with_contigs(list(batch.contig_names))
-        return VariantsDataset(header=header, variants=batch)
+        counters = reduce_counters(self._last_counters)
+        counters.retried_reads += ctx.retrier.retried
+        counters.skipped_blocks += ctx.skipped_blocks
+        counters.quarantined_blocks += ctx.quarantined_blocks
+        return VariantsDataset(header=header, variants=batch,
+                               counters=counters)
 
     # -- plain text ---------------------------------------------------------
 
-    def _read_plain(self, fs, path, header) -> VariantBatch:
+    def _read_plain(self, fs, path, header, ctx=None) -> VariantBatch:
         batches = []
-        for s in compute_path_splits(fs, path, self.split_size):
-            raw = [
-                ln for ln in lines_for_split(fs, path, s.start, s.end)
-                if ln and not ln.startswith(b"#")
-            ]
+        for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
+            shard_ctx = ctx.for_shard(i) if ctx is not None else None
+            lines = (
+                shard_ctx.retrier.call(
+                    lines_for_split, fs, path, s.start, s.end,
+                    what=f"split{i}")
+                if shard_ctx is not None
+                else lines_for_split(fs, path, s.start, s.end)
+            )
+            raw = [ln for ln in lines if ln and not ln.startswith(b"#")]
             batches.append(parse_vcf_lines(raw, header.contig_names))
+            self._track(shard_ctx, i, batches[-1])
         return VariantBatch.concat(batches) if batches else VariantBatch.empty(header.contig_names)
+
+    def _track(self, shard_ctx, shard_id: int, batch) -> None:
+        from disq_tpu.runtime import ShardCounters
+
+        if shard_ctx is None:
+            return
+        self._last_counters.append(
+            ShardCounters(
+                shard_id=shard_id,
+                records=int(batch.count),
+                skipped_blocks=shard_ctx.skipped_blocks,
+                quarantined_blocks=shard_ctx.quarantined_blocks,
+                retried_reads=shard_ctx.retrier.retried,
+            )
+        )
 
     def _read_whole_gzip(self, fs, path, header) -> VariantBatch:
         # Plain gzip is not splittable: one task reads the whole file
@@ -83,17 +118,77 @@ class VcfSource:
 
     # -- splittable bgzf ----------------------------------------------------
 
-    def _read_bgzf(self, fs, path, header) -> VariantBatch:
+    def _read_bgzf(self, fs, path, header, ctx=None) -> VariantBatch:
         length = fs.get_file_length(path)
         batches = []
-        for s in compute_path_splits(fs, path, self.split_size):
-            raw = self._bgzf_split_lines(fs, path, s.start, s.end, length)
+        for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
+            shard_ctx = ctx.for_shard(i) if ctx is not None else None
+            if shard_ctx is not None:
+                raw = shard_ctx.retrier.call(
+                    self._bgzf_split_lines, fs, path, s.start, s.end,
+                    length, ctx=shard_ctx, what=f"split{i}",
+                )
+            else:
+                raw = self._bgzf_split_lines(fs, path, s.start, s.end, length)
             raw = [ln for ln in raw if ln and not ln.startswith(b"#")]
             batches.append(parse_vcf_lines(raw, header.contig_names))
+            self._track(shard_ctx, i, batches[-1])
         return VariantBatch.concat(batches) if batches else VariantBatch.empty(header.contig_names)
 
+    def _inflate_with_gaps(self, data, blocks, gaps, base: int, ctx):
+        """``_inflate_with_policy`` when the block walk itself needed
+        salvage: corrupt-header spans (``gaps``, already policy-handled
+        by the walk) contribute one NUL each — their true decompressed
+        size is unknowable, and a single NUL taints the lines on either
+        side of the hole without splicing them."""
+        if not gaps:
+            return self._inflate_with_policy(data, blocks, base, ctx)
+        from disq_tpu.runtime.errors import inflate_blocks_salvage
+
+        payloads = inflate_blocks_salvage(data, blocks, base, ctx)
+        from disq_tpu.bgzf.block import BGZF_MAX_PAYLOAD
+
+        items = sorted(
+            [(b.pos, p if p is not None
+              else b"\x00" * min(max(b.usize, 1), BGZF_MAX_PAYLOAD))
+             for b, p in zip(blocks, payloads)]
+            + [(lo, b"\x00") for lo, _hi in gaps]
+        )
+        return b"".join(p for _, p in items), True
+
+    @staticmethod
+    def _inflate_with_policy(data, blocks, base: int, ctx) -> "tuple[bytes, bool]":
+        """Batched inflate with corrupt-block salvage for *text* data:
+        a skipped/quarantined block is replaced by NUL filler of its
+        claimed decompressed size, keeping every other block's line
+        positions (and therefore split line ownership) stable; lines
+        touching filler are dropped by the caller. NUL never occurs in
+        well-formed VCF text."""
+        from disq_tpu.bgzf.block import BGZF_MAX_PAYLOAD
+        from disq_tpu.bgzf.codec import inflate_blocks as _inflate
+        from disq_tpu.runtime.errors import inflate_blocks_salvage
+
+        try:
+            return _inflate(data, blocks, base=base), False
+        except ValueError:
+            if ctx is None:
+                raise
+            payloads = inflate_blocks_salvage(data, blocks, base, ctx)
+            # b.usize comes from the block's own ISIZE footer — in a
+            # corrupt block that field is itself untrusted: clamp the
+            # filler to the BGZF spec maximum (a damaged high byte must
+            # not provoke a multi-GiB allocation) and to at least one
+            # NUL (an ISIZE damaged to 0 must still taint the lines on
+            # either side of the hole, not splice them into one bogus
+            # record).
+            return b"".join(
+                p if p is not None
+                else b"\x00" * min(max(b.usize, 1), BGZF_MAX_PAYLOAD)
+                for b, p in zip(blocks, payloads)
+            ), any(p is None for p in payloads)
+
     def _bgzf_split_lines(
-        self, fs, path: str, start: int, end: int, length: int
+        self, fs, path: str, start: int, end: int, length: int, ctx=None
     ) -> List[bytes]:
         """Lines owned by this split under the Hadoop discard rule, in
         decompressed space: a split starting mid-stream discards through
@@ -101,28 +196,66 @@ class VcfSource:
         at any position ≤ its region length (including a line that begins
         exactly AT the region boundary — the neighbor will discard it).
         Mirrors ``fsw.textsplit.lines_for_split``'s boundary handling."""
+        if ctx is not None:
+            # Retried attempts must not double-count corrupt blocks.
+            ctx.skipped_blocks = 0
+            ctx.quarantined_blocks = 0
+        from disq_tpu.runtime.errors import TruncatedReadError
+
         g = BgzfBlockGuesser(fs, path)
         first = g.guess_block_start(start)
         if first is None or first >= end:
             return []
-        blocks, data = _walk_blocks_collect(fs, path, first, end, length)
+        gaps = []
+        try:
+            blocks, data = _walk_blocks_collect(fs, path, first, end, length)
+        except TruncatedReadError:
+            raise  # short range read: retried by the shard retrier
+        except ValueError:
+            # Malformed block header breaks the chain walk itself:
+            # salvage-walk the split, policy-handling each corrupt span
+            # and re-syncing at the next verifiable block (STRICT raises
+            # there with the span's coordinates).
+            if ctx is None:
+                raise
+            from disq_tpu.bgzf.guesser import walk_blocks_salvage
+
+            blocks, data, gaps = walk_blocks_salvage(
+                fs, path, first, end, length, ctx, owned_until=end)
         if not blocks:
             return []
-        owned = inflate_blocks(data, blocks, base=first)
+        owned, filled = self._inflate_with_gaps(
+            data, blocks, gaps, first, ctx)
         owned_len = len(owned)
         # Extend with neighbor blocks until a newline appears at-or-past
         # the owned region end, completing the straddling line (or the
         # line that starts exactly at the boundary, which we also own).
         ext = bytearray(owned)
+        ext_failed = False
         next_pos = blocks[-1].end
         while ext.find(b"\n", owned_len) < 0 and next_pos < length:
-            nxt, ndata = _walk_blocks_collect(
-                fs, path, next_pos, next_pos + 1, length,
-                chunk=2 * 0x10000,  # one max block + header slack, not 8 MiB
-            )
-            if not nxt:
+            try:
+                nxt, ndata = _walk_blocks_collect(
+                    fs, path, next_pos, next_pos + 1, length,
+                    chunk=2 * 0x10000,  # one max block + header slack
+                )
+            except TruncatedReadError:
+                raise
+            except ValueError:
+                if ctx is None:
+                    raise
+                # Corrupt neighbor header: the straddling line cannot be
+                # completed — drop it (its owner books the corruption).
+                ext_failed = True
                 break
-            ext += inflate_blocks(ndata, nxt, base=next_pos)
+            # Neighbor blocks belong to the NEXT split — salvage them
+            # silently so a corrupt one is counted only by its owner.
+            chunk, chunk_filled = self._inflate_with_policy(
+                ndata, nxt, next_pos,
+                ctx.silent() if ctx is not None else None,
+            )
+            ext += chunk
+            filled = filled or chunk_filled
             next_pos = nxt[-1].end
         text = bytes(ext)
         begin = 0
@@ -142,11 +275,18 @@ class VcfSource:
             nl = text.find(b"\n", pos)
             if nl < 0:
                 tail = text[pos:]
-                if tail:
+                if tail and not ext_failed:
                     out.append(tail)
                 break
             out.append(text[pos:nl])
             pos = nl + 1
+        # Lines touching a skipped corrupt block carry NUL filler
+        # (see _inflate_with_policy) — exactly that block's lines drop.
+        # Only filter when filler was actually inserted: a (spec-invalid
+        # but previously surfaced) NUL inside real data must not be
+        # silently dropped on the fault-free path.
+        if filled:
+            return [ln for ln in out if b"\x00" not in ln]
         return out
 
     # -- tabix pruning ------------------------------------------------------

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Randomized fault-injection soak over the BAM read pipeline.
+
+Each iteration draws a fresh seed, builds a randomized fault schedule
+(transient faults, truncated range reads, latency stalls — plus, in
+policy iterations, a bit flip in one randomly chosen BGZF block), runs
+an end-to-end read through the public API, and checks the recovery
+contract:
+
+- transient/truncate/stall schedules must yield output byte-identical
+  to the fault-free baseline;
+- a bit flip under ``skip``/``quarantine`` must lose records only from
+  the corrupted block, and under ``strict`` must raise
+  ``CorruptBlockError`` naming that block.
+
+Usage::
+
+    python scripts/chaos_soak.py --iterations 50
+    python scripts/chaos_soak.py --iterations 5 --records 200 --seed 7
+
+Exit status is non-zero if any iteration violates the contract, so CI
+can run this as a single command. Tier-1 stays fast: the pytest wrapper
+(``tests/test_fault_injection.py::test_chaos_soak_smoke``) is
+``slow``-marked and runs only 3 iterations.
+"""
+
+import argparse
+import os
+import random
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BLOCKSIZE = 600
+SPLIT = 4096
+
+
+def build_fixture(tmp_dir: str, n_records: int, seed: int):
+    from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+    records = synth_records(n_records, seed=seed, unmapped_tail=4)
+    data = make_bam_bytes(DEFAULT_REFS, records, blocksize=BLOCKSIZE)
+    path = os.path.join(tmp_dir, f"soak-{seed}.bam")
+    with open(path, "wb") as f:
+        f.write(data)
+    return path, data, len(records)
+
+
+def random_schedule(rng: random.Random):
+    from disq_tpu.fsw import FaultSpec
+
+    faults = [
+        FaultSpec(kind="transient", probability=rng.uniform(0.01, 0.08)),
+    ]
+    if rng.random() < 0.5:
+        faults.append(FaultSpec(
+            kind="truncate", probability=rng.uniform(0.01, 0.05),
+            truncate_bytes=rng.randint(1, 200)))
+    if rng.random() < 0.3:
+        faults.append(FaultSpec(
+            kind="stall", probability=0.02, stall_s=0.0))
+    return faults
+
+
+def pick_block(data: bytes, rng: random.Random) -> int:
+    """File offset of a random non-terminal BGZF block."""
+    from disq_tpu.bgzf.block import parse_block_header
+
+    layout = []
+    pos = 0
+    while pos < len(data):
+        total = parse_block_header(data, pos)
+        layout.append(pos)
+        pos += total
+    # skip block 0 (header) and the EOF terminator
+    return layout[rng.randint(1, max(1, len(layout) - 2))]
+
+
+def run_iteration(path, data, n_records, baseline, it_seed: int) -> str:
+    """One soak iteration; returns "" on success, else a description."""
+    import numpy as np
+
+    from disq_tpu import (
+        CorruptBlockError,
+        DisqOptions,
+        ErrorPolicy,
+        ReadsStorage,
+    )
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        FaultSpec,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+
+    rng = random.Random(it_seed)
+    faults = random_schedule(rng)
+    policy = rng.choice(["strict", "skip", "quarantine", "recover"])
+    corrupt_at = None
+    if policy != "recover":
+        corrupt_at = pick_block(data, rng)
+        # +1 damages the gzip magic (block *header* — exercises the
+        # chain-walk salvage); +20 damages the DEFLATE payload.
+        rel = rng.choice([1, 20])
+        faults = [FaultSpec(kind="bitflip", offset=corrupt_at + rel,
+                            bit=rng.randint(0, 7))] + (
+            faults if policy != "strict" else [])
+
+    fsw = FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(), faults, seed=it_seed)
+    register_filesystem("fault", fsw)
+    opts = DisqOptions(
+        error_policy=ErrorPolicy.coerce(
+            policy if policy != "recover" else "strict"),
+        max_retries=6, retry_backoff_s=0.0,
+        quarantine_dir=path + f".quarantine-{it_seed}",
+    )
+    storage = ReadsStorage.make_default().split_size(SPLIT).options(opts)
+
+    try:
+        ds = storage.read("fault://" + path)
+    except CorruptBlockError as e:
+        if policy == "strict" and e.block_offset == corrupt_at:
+            return ""
+        return (f"policy={policy}: unexpected CorruptBlockError "
+                f"at {e.block_offset} (corrupted {corrupt_at})")
+    except Exception as e:  # noqa: BLE001 — any other escape is a failure
+        return f"policy={policy}: {type(e).__name__}: {e}"
+
+    if policy == "strict":
+        return f"strict read of corrupt block {corrupt_at} did not raise"
+    if policy == "recover":
+        if ds.count() != n_records:
+            return (f"recover: {ds.count()} != {n_records} records "
+                    f"(faults fired: {fsw.fired_counts()})")
+        if not np.array_equal(ds.reads.pos, baseline.reads.pos) or \
+                not np.array_equal(ds.reads.names, baseline.reads.names):
+            return "recover: output differs from fault-free baseline"
+        return ""
+    # skip / quarantine: bounded loss, correct counters
+    lost = n_records - ds.count()
+    dropped = (ds.counters.skipped_blocks
+               + ds.counters.quarantined_blocks)
+    if dropped != 1:
+        return f"{policy}: dropped {dropped} blocks, expected 1"
+    # one 600-byte block holds at most ~18 minimum-size records
+    if not (0 < lost <= 20):
+        return f"{policy}: lost {lost} records from one block"
+    return ""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--records", type=int, default=400)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="master seed; each iteration derives its own")
+    args = ap.parse_args(argv)
+
+    from disq_tpu import ReadsStorage
+
+    with tempfile.TemporaryDirectory(prefix="chaos-soak-") as tmp:
+        path, data, n_records = build_fixture(tmp, args.records, args.seed)
+        baseline = ReadsStorage.make_default().split_size(SPLIT).read(path)
+        failures = []
+        for i in range(args.iterations):
+            it_seed = args.seed * 1_000_003 + i
+            err = run_iteration(path, data, n_records, baseline, it_seed)
+            status = "ok" if not err else f"FAIL: {err}"
+            print(f"[{i + 1}/{args.iterations}] seed={it_seed} {status}")
+            if err:
+                failures.append((it_seed, err))
+        print(f"{len(failures)} mismatches in {args.iterations} iterations")
+        for it_seed, err in failures:
+            print(f"  seed={it_seed}: {err}")
+        return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/chaos tests (excluded by tier-1)"
+    )
+
+
 @pytest.fixture()
 def tmp_fs():
     from disq_tpu.fsw import PosixFileSystemWrapper

@@ -1,0 +1,791 @@
+"""Recovery semantics under deterministic fault injection.
+
+The acceptance contract for the error-policy runtime (ISSUE 1): with
+seeded transient faults injected under a public-API BAM read, the
+decoded batch is byte-identical to the fault-free run (and the retries
+are visible in counters); with a flipped bit in one BGZF block, the
+three ``ErrorPolicy`` modes behave as specified — ``strict`` raises
+``CorruptBlockError`` naming the exact block, ``skip`` loses only that
+block's records, ``quarantine`` writes the sidecar + manifest.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+from dataclasses import fields
+
+import numpy as np
+import pytest
+
+from disq_tpu import (
+    CorruptBlockError,
+    DisqOptions,
+    ErrorPolicy,
+    ReadsStorage,
+)
+from disq_tpu.bgzf.block import parse_block_header
+from disq_tpu.fsw import (
+    FaultInjectingFileSystemWrapper,
+    FaultSpec,
+    PosixFileSystemWrapper,
+    register_filesystem,
+)
+
+from tests.bam_oracle import (
+    DEFAULT_REFS,
+    encode_record,
+    make_bam_bytes,
+    make_header_bytes,
+    synth_records,
+)
+
+BLOCKSIZE = 600  # uncompressed bytes per BGZF block in the fixture
+SPLIT = 4096    # hostile split size: many shards, many faultable reads
+
+
+@pytest.fixture(scope="module")
+def bam_file(tmp_path_factory):
+    records = synth_records(500, seed=7, unmapped_tail=6)
+    data = make_bam_bytes(DEFAULT_REFS, records, blocksize=BLOCKSIZE)
+    path = str(tmp_path_factory.mktemp("faultbam") / "in.bam")
+    with open(path, "wb") as f:
+        f.write(data)
+    return path, records, data
+
+
+@pytest.fixture(scope="module")
+def baseline(bam_file):
+    path, _, _ = bam_file
+    return ReadsStorage.make_default().split_size(SPLIT).read(path)
+
+
+def _block_layout(data):
+    """[(start, total_size)] of every BGZF block, in file order."""
+    out = []
+    pos = 0
+    while pos < len(data):
+        total = parse_block_header(data, pos)
+        out.append((pos, total))
+        pos += total
+    return out
+
+
+def _record_extents(records):
+    """Uncompressed [lo, hi) byte extent of each record in the payload."""
+    p = len(make_header_bytes(DEFAULT_REFS))
+    out = []
+    for r in records:
+        n = len(encode_record(r))
+        out.append((p, p + n))
+        p += n
+    return out
+
+
+def _read_with_faults(path, faults, seed=0, policy="strict",
+                      quarantine_dir=None, max_retries=3, split=SPLIT):
+    fsw = FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(), faults, seed=seed)
+    register_filesystem("fault", fsw)
+    opts = DisqOptions(
+        error_policy=ErrorPolicy.coerce(policy),
+        max_retries=max_retries,
+        retry_backoff_s=0.0,
+        quarantine_dir=quarantine_dir,
+    )
+    storage = ReadsStorage.make_default().split_size(split).options(opts)
+    return storage.read("fault://" + path), fsw
+
+
+def _assert_identical(a, b):
+    for f in fields(a):
+        np.testing.assert_array_equal(
+            getattr(a, f.name), getattr(b, f.name), err_msg=f.name)
+
+
+class TestTransientRecovery:
+    def test_seeded_faults_recover_byte_identical(self, bam_file, baseline):
+        """Transient p=0.05 on every range read: the read completes and
+        the output is byte-identical to the fault-free run."""
+        path, records, _ = bam_file
+        faults = [FaultSpec(kind="transient", probability=0.05,
+                            path_substr="in.bam")]
+        ds, fsw = _read_with_faults(path, faults, seed=1234)
+        assert fsw.fired_counts()[0][1] > 0, "schedule injected nothing"
+        assert ds.counters.retried_reads > 0
+        assert ds.count() == len(records)
+        _assert_identical(ds.reads, baseline.reads)
+
+    def test_same_seed_same_fault_sequence(self, bam_file):
+        """The schedule is a pure function of (seed, call sequence)."""
+        path, _, _ = bam_file
+        spec = [FaultSpec(kind="transient", probability=0.05,
+                          path_substr="in.bam")]
+        _, fsw_a = _read_with_faults(path, spec, seed=1234)
+        _, fsw_b = _read_with_faults(path, spec, seed=1234)
+        assert [(i.kind, i.start, i.length, i.call) for i in fsw_a.injected] \
+            == [(i.kind, i.start, i.length, i.call) for i in fsw_b.injected]
+
+    def test_truncated_reads_recover(self, bam_file, baseline):
+        """A connection cut mid-body (short range read) never corrupts
+        output: either the walker absorbs the short buffer or the read
+        is classified transient and retried."""
+        path, records, _ = bam_file
+        faults = [FaultSpec(kind="truncate", path_substr="in.bam",
+                            probability=0.10, truncate_bytes=37)]
+        ds, fsw = _read_with_faults(path, faults, seed=99)
+        assert fsw.fired_counts()[0][1] > 0
+        assert ds.count() == len(records)
+        _assert_identical(ds.reads, baseline.reads)
+
+    def test_stall_is_transparent(self, bam_file, baseline):
+        path, records, _ = bam_file
+        faults = [FaultSpec(kind="stall", path_substr="in.bam",
+                            call_index=1, stall_s=0.0, times=1)]
+        ds, fsw = _read_with_faults(path, faults)
+        assert [i.kind for i in fsw.injected] == ["stall"]
+        _assert_identical(ds.reads, baseline.reads)
+
+    def test_retry_budget_exhaustion_raises(self, bam_file):
+        """A persistent transient fault eventually surfaces (bounded
+        retries, no infinite loop)."""
+        path, _, _ = bam_file
+        faults = [FaultSpec(kind="transient", probability=1.0,
+                            path_substr="in.bam")]
+        with pytest.raises(IOError):
+            _read_with_faults(path, faults, max_retries=2)
+
+
+class TestCorruptBlockPolicies:
+    @pytest.fixture(scope="class")
+    def target(self, bam_file):
+        """A mid-file block to corrupt + the records that must survive
+        its loss (no byte overlap with the block's uncompressed span)."""
+        _, records, data = bam_file
+        layout = _block_layout(data)
+        blk_i = len(layout) // 2
+        start, total = layout[blk_i]
+        ulo, uhi = blk_i * BLOCKSIZE, (blk_i + 1) * BLOCKSIZE
+        surviving = [
+            r.name for r, (lo, hi) in zip(records, _record_extents(records))
+            if hi <= ulo or lo >= uhi
+        ]
+        assert len(surviving) < len(records)
+        return start, total, surviving
+
+    def _bitflip(self, start):
+        # +20 lands inside the DEFLATE payload (18-byte BGZF header)
+        return [FaultSpec(kind="bitflip", path_substr="in.bam",
+                          offset=start + 20, bit=3)]
+
+    def test_strict_raises_naming_the_block(self, bam_file, target):
+        # Whole-file read: the block is detected in its owning shard's
+        # decode, so the error carries full (shard, block) coordinates.
+        path, _, _ = bam_file
+        start, _, _ = target
+        with pytest.raises(CorruptBlockError) as ei:
+            _read_with_faults(path, self._bitflip(start), policy="strict",
+                              split=10**9)
+        e = ei.value
+        assert e.block_offset == start
+        assert e.path.endswith("in.bam")
+        assert e.shard_id == 0
+        assert str(start) in str(e)  # coordinates are in the message
+
+    def test_strict_raises_from_boundary_search_too(self, bam_file, target):
+        # Tiny splits: the corrupt block can surface during split-boundary
+        # guessing, before any shard owns it — still named exactly.
+        path, _, _ = bam_file
+        start, _, _ = target
+        with pytest.raises(CorruptBlockError) as ei:
+            _read_with_faults(path, self._bitflip(start), policy="strict")
+        assert ei.value.block_offset == start
+
+    def test_skip_loses_only_that_blocks_records(self, bam_file, target):
+        path, records, _ = bam_file
+        start, _, surviving = target
+        ds, _ = _read_with_faults(path, self._bitflip(start), policy="skip")
+        got = [ds.reads.name(i) for i in range(int(ds.reads.count))]
+        assert got == surviving
+        assert ds.counters.skipped_blocks == 1
+        assert ds.counters.quarantined_blocks == 0
+
+    def test_quarantine_writes_sidecar_and_manifest(
+            self, bam_file, target, tmp_path):
+        path, _, data = bam_file
+        start, total, surviving = target
+        qdir = str(tmp_path / "quar")
+        ds, _ = _read_with_faults(
+            path, self._bitflip(start), policy="quarantine",
+            quarantine_dir=qdir)
+        assert ds.counters.quarantined_blocks == 1
+        assert ds.counters.skipped_blocks == 0
+        got = [ds.reads.name(i) for i in range(int(ds.reads.count))]
+        assert got == surviving  # data outcome identical to skip
+        with open(os.path.join(qdir, "MANIFEST.jsonl")) as f:
+            lines = [json.loads(ln) for ln in f.read().splitlines()]
+        assert lines[0] == {"version": 1}
+        [entry] = lines[1:]
+        assert entry["block_offset"] == start
+        assert entry["kind"] == "BGZF block"
+        with open(entry["sidecar"], "rb") as f:
+            raw = f.read()
+        expected = bytearray(data[start:start + total])
+        expected[20] ^= 1 << 3  # the corrupt bytes, as read
+        assert raw == bytes(expected)
+        assert entry["length"] == len(raw)
+
+
+class TestAtomicCreate:
+    """PosixFileSystemWrapper.create stages to a tmp sibling and commits
+    on close — a killed writer never leaves a truncated final file."""
+
+    def test_partial_write_invisible_until_close(self, tmp_path):
+        fs = PosixFileSystemWrapper()
+        dest = str(tmp_path / "out.bin")
+        f = fs.create(dest)
+        f.write(b"partial")
+        assert not os.path.exists(dest)       # crash here = no file
+        assert not fs.exists(dest)
+        f.close()
+        with open(dest, "rb") as g:
+            assert g.read() == b"partial"
+
+    def test_no_tmp_visible_or_left_behind(self, tmp_path):
+        fs = PosixFileSystemWrapper()
+        dest = str(tmp_path / "out.bin")
+        f = fs.create(dest)
+        f.write(b"x")
+        # the staging file is hidden from directory listings
+        assert fs.list_directory(str(tmp_path)) == []
+        f.close()
+        assert os.listdir(str(tmp_path)) == ["out.bin"]
+
+    def test_double_close_idempotent(self, tmp_path):
+        fs = PosixFileSystemWrapper()
+        dest = str(tmp_path / "out.bin")
+        f = fs.create(dest)
+        f.write(b"y")
+        f.close()
+        f.close()  # second close must not re-replace / raise
+        with open(dest, "rb") as g:
+            assert g.read() == b"y"
+
+
+@pytest.mark.slow
+def test_chaos_soak_smoke():
+    """One-command randomized soak (scripts/chaos_soak.py) — small N
+    here; the script scales N up for real soak runs."""
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "chaos_soak.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--iterations", "3", "--records", "200",
+         "--seed", "7"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 mismatches" in proc.stdout
+
+
+class TestOwnershipSingleCount:
+    """A corrupt block read by two shards (boundary straddle, VCF
+    straddling-line extension) must be counted/quarantined exactly once
+    — by its owner."""
+
+    def test_boundary_straddle_block_counted_once(self, bam_file):
+        from disq_tpu.bam.source import BamSource, read_header
+        from disq_tpu.fsw.filesystem import compute_path_splits
+
+        path, records, data = bam_file
+        fs = PosixFileSystemWrapper()
+        header, vo = read_header(fs, path)
+        src = BamSource()
+        splits = compute_path_splits(fs, path, SPLIT)
+        bounds = src._split_boundaries(fs, path, header, vo, splits, None)
+        # a boundary landing mid-block (u > 0): that block is walked by
+        # the shard before it AND owned by the shard after it
+        straddle = next(b >> 16 for b in bounds[1:-1] if b & 0xFFFF > 0)
+        faults = [FaultSpec(kind="bitflip", path_substr="in.bam",
+                            offset=straddle + 20, bit=2)]
+        ds, _ = _read_with_faults(path, faults, policy="skip")
+        assert ds.counters.skipped_blocks == 1
+        # lost records are exactly those overlapping the block's
+        # uncompressed span
+        layout = _block_layout(data)
+        blk_i = next(i for i, (s, _) in enumerate(layout) if s == straddle)
+        ulo, uhi = blk_i * BLOCKSIZE, (blk_i + 1) * BLOCKSIZE
+        surviving = [
+            r.name for r, (lo, hi) in zip(records, _record_extents(records))
+            if hi <= ulo or lo >= uhi
+        ]
+        got = [ds.reads.name(i) for i in range(int(ds.reads.count))]
+        assert got == surviving
+
+    def test_vcf_extension_block_counted_once(self, tmp_path):
+        from disq_tpu import VariantsStorage
+        from tests.bam_oracle import o_bgzf_compress
+
+        head = (b"##fileformat=VCFv4.2\n"
+                b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        body = b"".join(
+            b"chr1\t%d\t.\tACGTACGTACGT\tA\t50\tPASS\tDP=%d\n" % (i + 1, i)
+            for i in range(400))
+        text = head + body
+        data = o_bgzf_compress(text, blocksize=600)
+        layout = _block_layout(data)
+        blk_i = len(layout) // 2
+        start, _ = layout[blk_i]
+        bad = bytearray(data)
+        bad[start + 20] ^= 0x04
+        path = str(tmp_path / "v.vcf.gz")
+        with open(path, "wb") as f:
+            f.write(bytes(bad))
+        # split boundary exactly at the corrupt block: the previous
+        # split's straddling-line extension reads it (silently), the
+        # next split owns it (counts it)
+        opts = DisqOptions(error_policy=ErrorPolicy.SKIP,
+                           retry_backoff_s=0.0)
+        ds = (VariantsStorage.make_default().split_size(start)
+              .options(opts).read(path))
+        assert ds.counters.skipped_blocks == 1
+        # surviving lines = those not overlapping the corrupt block's
+        # uncompressed span
+        ulo, uhi = blk_i * 600, (blk_i + 1) * 600
+        expected, off = [], 0
+        for ln in text.splitlines(keepends=True):
+            s, e = off, off + len(ln)
+            off = e
+            if ln.startswith(b"#"):
+                continue
+            if e <= ulo or s >= uhi:
+                expected.append(int(ln.split(b"\t")[1]))
+        assert list(ds.variants.pos) == expected
+
+
+class TestReviewFixes:
+    def test_with_block_exception_aborts_commit(self, tmp_path):
+        fs = PosixFileSystemWrapper()
+        dest = str(tmp_path / "out.bin")
+        with pytest.raises(RuntimeError):
+            with fs.create(dest) as f:
+                f.write(b"half")
+                raise RuntimeError("writer died")
+        assert not os.path.exists(dest)       # nothing published
+        assert os.listdir(str(tmp_path)) == []  # tmp cleaned up
+
+    def test_abort_discards(self, tmp_path):
+        fs = PosixFileSystemWrapper()
+        dest = str(tmp_path / "out.bin")
+        f = fs.create(dest)
+        f.write(b"half")
+        f.abort()
+        assert not os.path.exists(dest)
+        assert os.listdir(str(tmp_path)) == []
+
+    def test_cache_keeps_just_inserted_key_under_inflight_pressure(self):
+        from concurrent.futures import Future
+
+        from disq_tpu.fsw.http import HttpFileSystemWrapper
+
+        fs = HttpFileSystemWrapper(max_cached_blocks=2)
+        stalled = [Future() for _ in range(2)]
+        with fs._lock:
+            for i, fut in enumerate(stalled):
+                fs._cache_put(("u", i), fut)
+            fs._cache_put(("u", 99), b"fresh")
+        # the fresh block must survive even though every older entry is
+        # an unevictable in-flight Future
+        assert fs._cache[("u", 99)] == b"fresh"
+        for fut in stalled:
+            fut.cancel()
+
+    def test_traversal_read_retries_transients(self, bam_file, tmp_path):
+        from disq_tpu import BaiWriteOption, TraversalParameters
+        from disq_tpu.api import Interval
+
+        path, records, _ = bam_file
+        storage = ReadsStorage.make_default().num_shards(2)
+        sorted_path = str(tmp_path / "sorted.bam")
+        storage.write(storage.read(path), sorted_path,
+                      BaiWriteOption.ENABLE, sort=True)
+        # index-driven traversal over the fault scheme: transient faults
+        # are retried whole-phase and surfaced in counters
+        faults = [FaultSpec(kind="transient", path_substr="sorted.bam",
+                            call_index=2, times=1)]
+        fsw = FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(), faults, seed=5)
+        register_filesystem("fault", fsw)
+        opts = DisqOptions(retry_backoff_s=0.0)
+        traversal = TraversalParameters(
+            intervals=[Interval("chr1", 1, 100_000)])
+        ds = (ReadsStorage.make_default().options(opts)
+              .read("fault://" + sorted_path, traversal=traversal))
+        assert fsw.fired_counts()[0][1] == 1
+        assert ds.counters.retried_reads > 0
+        assert ds.count() > 0
+
+
+class TestQuarantineLedger:
+    def test_two_inputs_share_dir_without_collision(self, tmp_path):
+        from disq_tpu import QuarantineManifest
+
+        q = QuarantineManifest(str(tmp_path / "q"))
+        s1 = q.quarantine("a.bam", 100, b"AAA")
+        s2 = q.quarantine("b.bam", 100, b"BBB")
+        assert s1 != s2
+        with open(s1, "rb") as f:
+            assert f.read() == b"AAA"
+        with open(s2, "rb") as f:
+            assert f.read() == b"BBB"
+        assert len(q.entries) == 2
+
+    def test_reload_last_wins_and_torn_line_ignored(self, tmp_path):
+        from disq_tpu import QuarantineManifest
+
+        base = str(tmp_path / "q")
+        q = QuarantineManifest(base)
+        q.quarantine("a.bam", 1, b"old", error="first")
+        q.quarantine("a.bam", 1, b"new!", error="second")
+        with open(q.path, "a") as f:
+            f.write('{"path": "torn')  # crash mid-append
+        r = QuarantineManifest(base)
+        [entry] = r.entries
+        assert entry["error"] == "second"
+        assert entry["length"] == 4
+
+    def test_vcf_corrupt_isize_filler_is_clamped(self, tmp_path):
+        """A bit flip in a block's own ISIZE footer must not balloon the
+        skip-policy NUL filler into a multi-MiB allocation."""
+        from disq_tpu import VariantsStorage
+        from tests.bam_oracle import o_bgzf_compress
+
+        head = (b"##fileformat=VCFv4.2\n"
+                b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        body = b"".join(
+            b"chr1\t%d\t.\tA\tC\t50\tPASS\tDP=%d\n" % (i + 1, i)
+            for i in range(300))
+        data = o_bgzf_compress(head + body, blocksize=600)
+        layout = _block_layout(data)
+        start, total = layout[len(layout) // 2]
+        bad = bytearray(data)
+        bad[start + total - 2] ^= 0x80  # ISIZE claims ~8 MiB extra
+        path = str(tmp_path / "v.vcf.gz")
+        with open(path, "wb") as f:
+            f.write(bytes(bad))
+        opts = DisqOptions(error_policy=ErrorPolicy.SKIP,
+                           retry_backoff_s=0.0)
+        ds = VariantsStorage.make_default().options(opts).read(path)
+        assert ds.counters.skipped_blocks == 1
+        assert 300 - 40 < ds.count() < 300
+
+
+class TestConcurrentCreate:
+    def test_two_writers_same_path_no_interleave(self, tmp_path):
+        fs = PosixFileSystemWrapper()
+        dest = str(tmp_path / "out.bin")
+        w1, w2 = fs.create(dest), fs.create(dest)
+        w1.write(b"aaaa")
+        w2.write(b"bb")
+        w1.close()
+        with open(dest, "rb") as f:
+            assert f.read() == b"aaaa"
+        w2.close()  # last close wins cleanly, no FileNotFoundError
+        with open(dest, "rb") as f:
+            assert f.read() == b"bb"
+        assert os.listdir(str(tmp_path)) == ["out.bin"]
+
+
+class TestHeaderCorruption:
+    """A bit flip in a BGZF block *header* breaks the BSIZE chain walk
+    itself — the salvage walk must policy-handle the span and re-sync at
+    the next verifiable block."""
+
+    def _flip_header(self, start):
+        # +1 hits the gzip magic's second byte (0x8b): header malformed
+        return [FaultSpec(kind="bitflip", path_substr="in.bam",
+                          offset=start + 1, bit=0)]
+
+    def test_strict_raises_naming_the_block(self, bam_file):
+        path, records, data = bam_file
+        start, _ = _block_layout(data)[len(_block_layout(data)) // 2]
+        with pytest.raises(CorruptBlockError) as ei:
+            _read_with_faults(path, self._flip_header(start),
+                              policy="strict", split=10**9)
+        assert ei.value.block_offset == start
+        assert "header" in str(ei.value)
+
+    def test_skip_drops_only_that_block(self, bam_file):
+        path, records, data = bam_file
+        layout = _block_layout(data)
+        blk_i = len(layout) // 2
+        start, _ = layout[blk_i]
+        ds, _ = _read_with_faults(path, self._flip_header(start),
+                                  policy="skip", split=10**9)
+        assert ds.counters.skipped_blocks == 1
+        ulo, uhi = blk_i * BLOCKSIZE, (blk_i + 1) * BLOCKSIZE
+        surviving = [
+            r.name for r, (lo, hi) in zip(records, _record_extents(records))
+            if hi <= ulo or lo >= uhi
+        ]
+        got = [ds.reads.name(i) for i in range(int(ds.reads.count))]
+        assert got == surviving
+
+    def test_quarantine_sidecars_the_span(self, bam_file, tmp_path):
+        path, _, data = bam_file
+        start, _ = _block_layout(data)[len(_block_layout(data)) // 2]
+        qdir = str(tmp_path / "q")
+        ds, _ = _read_with_faults(path, self._flip_header(start),
+                                  policy="quarantine", quarantine_dir=qdir,
+                                  split=10**9)
+        assert ds.counters.quarantined_blocks == 1
+        with open(os.path.join(qdir, "MANIFEST.jsonl")) as f:
+            lines = [json.loads(ln) for ln in f.read().splitlines()]
+        [entry] = lines[1:]
+        assert entry["block_offset"] == start
+        assert entry["kind"] == "BGZF block header"
+
+    def test_file_truncated_mid_block_is_corrupt_not_transient(
+            self, bam_file, tmp_path):
+        """A file cut mid-block is deterministic damage: skip policy
+        drops the tail without burning the transient-retry budget."""
+        path, records, data = bam_file
+        cut = str(tmp_path / "cut.bam")
+        with open(cut, "wb") as f:
+            f.write(data[:-40])  # into the final data block / EOF marker
+        opts = DisqOptions(error_policy=ErrorPolicy.SKIP,
+                           retry_backoff_s=0.0)
+        ds = ReadsStorage.make_default().options(opts).read(cut)
+        assert ds.counters.retried_reads == 0  # never classified transient
+        assert ds.counters.skipped_blocks >= 1
+        assert len(records) - 30 < ds.count() < len(records) + 1
+
+
+class TestFaultFreeFidelity:
+    def test_nul_byte_in_vcf_data_survives(self, tmp_path):
+        """The corrupt-block NUL filter must not run on the fault-free
+        path: real (spec-hostile) NUL bytes in a record are kept."""
+        from disq_tpu import VariantsStorage
+        from tests.bam_oracle import o_bgzf_compress
+
+        head = (b"##fileformat=VCFv4.2\n"
+                b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        lines = [b"chr1\t%d\t.\tA\tC\t50\tPASS\tDP=1\n" % (i + 1)
+                 for i in range(50)]
+        lines[25] = b"chr1\t26\t.\tA\tC\t50\tPASS\tXX=a\x00b\n"
+        data = o_bgzf_compress(head + b"".join(lines), blocksize=300)
+        path = str(tmp_path / "n.vcf.gz")
+        with open(path, "wb") as f:
+            f.write(data)
+        ds = VariantsStorage.make_default().split_size(400).read(path)
+        assert ds.count() == 50
+
+    def test_foreign_ledger_rotated_not_corrupted(self, tmp_path):
+        from disq_tpu import QuarantineManifest
+
+        base = str(tmp_path / "q")
+        os.makedirs(base)
+        ledger = os.path.join(base, QuarantineManifest.MANIFEST_NAME)
+        with open(ledger, "w") as f:
+            f.write('{"version": 99}\n{"path": "x", "block_offset": 1}\n')
+        q = QuarantineManifest(base)
+        assert q.entries == []  # foreign version: not merged
+        q.quarantine("a.bam", 7, b"zz")
+        # the foreign ledger was set aside, not appended into
+        with open(ledger) as f:
+            lines = [json.loads(ln) for ln in f.read().splitlines()]
+        assert lines[0] == {"version": 1}
+        assert lines[1]["block_offset"] == 7
+        with open(ledger + ".bak") as f:
+            assert json.loads(f.readline())["version"] == 99
+
+
+class TestRecordFramingDamage:
+    """Corruption that predates compression: BGZF blocks are intact
+    (CRC passes) but the BAM record block_size chain is impossible."""
+
+    @pytest.fixture()
+    def framed_bam(self, tmp_path):
+        from tests.bam_oracle import encode_record as enc
+        from tests.bam_oracle import o_bgzf_compress
+
+        records = synth_records(200, seed=3)
+        payload = bytearray(make_header_bytes(DEFAULT_REFS))
+        extents = []
+        for r in records:
+            b = enc(r)
+            extents.append((len(payload), len(payload) + len(b)))
+            payload += b
+        # wreck record 120's block_size field (huge value)
+        lo, _ = extents[120]
+        payload[lo: lo + 4] = (0x7FFFFFF0).to_bytes(4, "little")
+        path = str(tmp_path / "in.bam")
+        with open(path, "wb") as f:
+            f.write(o_bgzf_compress(bytes(payload), blocksize=600))
+        return path, records
+
+    def test_strict_raises_record_run(self, framed_bam):
+        path, _ = framed_bam
+        opts = DisqOptions(retry_backoff_s=0.0)
+        with pytest.raises(CorruptBlockError) as ei:
+            ReadsStorage.make_default().options(opts).read(path)
+        assert "record run" in str(ei.value)
+
+    def test_skip_keeps_clean_prefix(self, framed_bam):
+        path, records = framed_bam
+        opts = DisqOptions(error_policy=ErrorPolicy.SKIP,
+                           retry_backoff_s=0.0)
+        ds = ReadsStorage.make_default().options(opts).read(path)
+        assert ds.counters.skipped_blocks == 1
+        got = [ds.reads.name(i) for i in range(int(ds.reads.count))]
+        assert got == [r.name for r in records[:120]]
+
+
+class TestVcfHeaderCorruption:
+    def test_skip_resyncs_instead_of_dropping_split(self, tmp_path):
+        """A corrupt block HEADER in a VCF split must lose only that
+        block's lines (salvage walk + re-sync), not the whole split."""
+        from disq_tpu import VariantsStorage
+        from tests.bam_oracle import o_bgzf_compress
+
+        head = (b"##fileformat=VCFv4.2\n"
+                b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        text = head + b"".join(
+            b"chr1\t%d\t.\tACGT\tA\t50\tPASS\tDP=%d\n" % (i + 1, i)
+            for i in range(400))
+        data = o_bgzf_compress(text, blocksize=600)
+        layout = _block_layout(data)
+        blk_i = len(layout) // 2
+        start, _ = layout[blk_i]
+        bad = bytearray(data)
+        bad[start + 1] ^= 0x01  # gzip magic: header malformed
+        path = str(tmp_path / "v.vcf.gz")
+        with open(path, "wb") as f:
+            f.write(bytes(bad))
+        opts = DisqOptions(error_policy=ErrorPolicy.SKIP,
+                           retry_backoff_s=0.0)
+        ds = VariantsStorage.make_default().options(opts).read(path)
+        assert ds.counters.skipped_blocks == 1
+        ulo, uhi = blk_i * 600, (blk_i + 1) * 600
+        expected, off = [], 0
+        for ln in text.splitlines(keepends=True):
+            s, e = off, off + len(ln)
+            off = e
+            if ln.startswith(b"#"):
+                continue
+            if e <= ulo or s >= uhi:
+                expected.append(int(ln.split(b"\t")[1]))
+        assert list(ds.variants.pos) == expected
+
+
+class TestCramContainerHeaderCorruption:
+    @pytest.fixture()
+    def cram_file(self, tmp_path):
+        from tests.bam_oracle import make_bam_bytes as mk
+
+        records = synth_records(300, seed=9, sorted_coord=True,
+                                with_edge_cases=False)
+        bam = str(tmp_path / "in.bam")
+        with open(bam, "wb") as f:
+            f.write(mk(DEFAULT_REFS, records, sort_order="coordinate"))
+        st = ReadsStorage.make_default().num_shards(3)
+        out = str(tmp_path / "out.cram")
+        st.write(st.read(bam), out)
+        return out, len(records)
+
+    def _corrupt_second_container(self, path, tmp_path):
+        from disq_tpu.cram.structure import walk_container_offsets
+        from disq_tpu.fsw import PosixFileSystemWrapper
+
+        offs = [o for o, h in walk_container_offsets(
+            PosixFileSystemWrapper(), path) if not h.is_eof]
+        target = offs[2] if len(offs) > 2 else offs[-1]
+        with open(path, "rb") as f:
+            raw = bytearray(f.read())
+        # 0xFF-fill the header's leading varints: the parse reliably
+        # overruns its window and raises, instead of silently drifting
+        raw[target: target + 8] = b"\xff" * 8
+        bad = str(tmp_path / "bad.cram")
+        with open(bad, "wb") as f:
+            f.write(bytes(raw))
+        return bad, target
+
+    def test_strict_raises(self, cram_file, tmp_path):
+        path, _ = cram_file
+        bad, _ = self._corrupt_second_container(path, tmp_path)
+        opts = DisqOptions(retry_backoff_s=0.0)
+        with pytest.raises((CorruptBlockError, ValueError)):
+            ReadsStorage.make_default().options(opts).read(bad)
+
+    def test_skip_keeps_prefix_and_counts(self, cram_file, tmp_path):
+        path, total = cram_file
+        bad, _ = self._corrupt_second_container(path, tmp_path)
+        opts = DisqOptions(error_policy=ErrorPolicy.SKIP,
+                           retry_backoff_s=0.0)
+        ds = ReadsStorage.make_default().options(opts).read(bad)
+        dropped = (ds.counters.skipped_blocks
+                   + ds.counters.quarantined_blocks)
+        assert dropped >= 1
+        assert 0 < ds.count() < total
+
+
+class TestStreamShortReads:
+    class _Dribble(io.RawIOBase):
+        """Stream that once, mid-file, returns 5 of 18 requested header
+        bytes — a buffering/flaky stream that is NOT at EOF."""
+
+        def __init__(self, b):
+            self._b, self._p, self._tricked = b, 0, False
+
+        def readable(self):
+            return True
+
+        def seekable(self):
+            return True
+
+        def seek(self, p, w=0):
+            self._p = p if w == 0 else (self._p + p)
+            return self._p
+
+        def read(self, n=-1):
+            if n is None or n < 0:
+                n = len(self._b) - self._p
+            if not self._tricked and self._p > 70_000 and n == 18:
+                self._tricked = True
+                n = 5
+            out = self._b[self._p: self._p + n]
+            self._p += len(out)
+            return out
+
+    def test_short_header_read_is_not_eof(self):
+        from disq_tpu.bgzf.codec import BgzfReader, compress_to_bgzf
+
+        # incompressible payload, so the compressed stream is long
+        # enough for the mid-file trick to trigger
+        payload = np.random.default_rng(0).integers(
+            0, 256, 200_000, dtype=np.uint8).tobytes()
+        src = self._Dribble(compress_to_bgzf(payload))
+        r = BgzfReader(src)
+        assert r.read(len(payload)) == payload
+        assert src._tricked  # the short read actually happened
+
+    def test_file_ends_mid_header_raises_corrupt(self, tmp_path):
+        from disq_tpu.bgzf.block import parse_block_header
+        from disq_tpu.bgzf.codec import BgzfReader, compress_to_bgzf
+
+        data = compress_to_bgzf(b"y" * 100_000)
+        first = parse_block_header(data, 0)
+        r = BgzfReader(io.BytesIO(data[: first + 7]))
+        with pytest.raises(ValueError, match="mid-header"):
+            r.read(100_000)
+
+
+def test_remote_quarantine_requires_explicit_dir():
+    from disq_tpu.runtime.errors import ErrorPolicy, ShardErrorContext
+
+    ctx = ShardErrorContext(policy=ErrorPolicy.QUARANTINE,
+                            path="gs://bucket/x.bam")
+    with pytest.raises(ValueError, match="quarantine_dir"):
+        ctx.handle_corrupt_block(ValueError("bad"), block_offset=0, raw=b"z")

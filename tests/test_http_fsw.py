@@ -236,3 +236,110 @@ class TestTransientRetry:
             assert fs.stats.bytes_fetched == len(payload)
         finally:
             srv.shutdown()
+
+
+class TestExistsRetry:
+    """HEAD goes through the same timeout + transient-retry discipline
+    as ranged GETs (a stalled/5xx HEAD must not hang or misreport)."""
+
+    def _serve(self, handler_cls):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_503_head_then_success(self):
+        class FlakyHead(_RangeHandler):
+            files = {"/f.bin": b"x" * 1000}
+            fails = {"n": 2}
+
+            def do_HEAD(self):
+                if self.fails["n"] > 0:
+                    self.fails["n"] -= 1
+                    self.send_error(503)
+                    return
+                super().do_HEAD()
+
+        srv = self._serve(FlakyHead)
+        try:
+            fs = HttpFileSystemWrapper()
+            fs._BACKOFF_S = 0.01
+            url = f"http://127.0.0.1:{srv.server_address[1]}/f.bin"
+            assert fs.exists(url) is True
+            assert fs.stats.retries >= 2
+            # the successful HEAD cached the length
+            assert fs.get_file_length(url) == 1000
+        finally:
+            srv.shutdown()
+
+    def test_missing_key_no_retry(self):
+        class Counting(_RangeHandler):
+            files = {}
+            heads = {"n": 0}
+
+            def do_HEAD(self):
+                self.heads["n"] += 1
+                super().do_HEAD()
+
+        srv = self._serve(Counting)
+        try:
+            fs = HttpFileSystemWrapper()
+            fs._BACKOFF_S = 0.01
+            url = f"http://127.0.0.1:{srv.server_address[1]}/nope"
+            assert fs.exists(url) is False
+            assert Counting.heads["n"] == 1  # 404 is definitive: one HEAD
+            assert fs.stats.retries == 0
+        finally:
+            srv.shutdown()
+
+    def test_persistent_failure_raises_after_budget(self):
+        class AlwaysDown(_RangeHandler):
+            files = {}
+            heads = {"n": 0}
+
+            def do_HEAD(self):
+                self.heads["n"] += 1
+                self.send_error(503)
+
+        srv = self._serve(AlwaysDown)
+        try:
+            fs = HttpFileSystemWrapper()
+            fs._BACKOFF_S = 0.01
+            url = f"http://127.0.0.1:{srv.server_address[1]}/f"
+            with pytest.raises(Exception):
+                fs.exists(url)
+            assert AlwaysDown.heads["n"] == fs._RETRIES + 1
+        finally:
+            srv.shutdown()
+
+
+class TestCacheEviction:
+    """LRU eviction must skip in-flight prefetch Futures, not stop at
+    them: a stalled fetch at the head must not let the cache exceed
+    max_cached_blocks."""
+
+    def test_inflight_future_does_not_block_eviction(self):
+        from concurrent.futures import Future
+
+        fs = HttpFileSystemWrapper(max_cached_blocks=4)
+        stalled = Future()  # never completes
+        with fs._lock:
+            fs._cache_put(("u", 0), stalled)
+            for i in range(1, 8):
+                fs._cache_put(("u", i), b"data")
+        # bound respected, completed blocks evicted, Future retained
+        assert len(fs._cache) <= fs.max_cached_blocks
+        assert ("u", 0) in fs._cache
+        stalled.cancel()
+
+    def test_completed_future_is_evictable(self):
+        from concurrent.futures import Future
+
+        fs = HttpFileSystemWrapper(max_cached_blocks=2)
+        done = Future()
+        done.set_result(b"done")
+        with fs._lock:
+            fs._cache_put(("u", 0), done)
+            fs._cache_put(("u", 1), b"a")
+            fs._cache_put(("u", 2), b"b")
+        assert len(fs._cache) <= 2
+        assert ("u", 0) not in fs._cache  # done Future evicted first (LRU)
