@@ -282,6 +282,115 @@ def secondary_configs(storage, path: str, tmp: str, reps: int) -> dict:
     return out
 
 
+EXEC_WORKERS = [
+    int(w) for w in os.environ.get("BENCH_EXEC_WORKERS", "1,2,8").split(",")
+]
+
+
+def executor_scaling_config(path: str, reps: int) -> dict:
+    """Config 1 parameterized by ``executor_workers``: the same BAM
+    decode through the shard-pipeline executor at each worker count,
+    so the fetch/inflate/decode overlap (or its absence) is a row in
+    BENCH_*.json, not an assertion."""
+    from disq_tpu import ReadsStorage
+
+    rows = {}
+    for w in EXEC_WORKERS:
+        storage = (ReadsStorage.make_default()
+                   .split_size(8 * 1024 * 1024).executor_workers(w))
+
+        def run():
+            assert storage.read(path).count() == N_RECORDS
+
+        run()
+        med, times = _timed(run, reps)
+        rows[f"workers_{w}"] = {
+            "records_per_sec": round(N_RECORDS / med, 1),
+            "spread": _spread(times),
+        }
+    return {"6_bam_decode_executor_scaling": rows}
+
+
+def http_read_config(path: str, reps: int) -> dict:
+    """Remote-read row: the bench BAM served by an in-process HTTP
+    range server (zero egress), read at each ``executor_workers`` —
+    the latency-bound path the pipelined executor exists for. Each GET
+    carries ``BENCH_HTTP_LATENCY_MS`` of simulated RTT (default 10 ms;
+    localhost alone is CPU-bound and would misrepresent the remote
+    regime BENCH_r05 showed to be latency-bound). A fresh wrapper per
+    run keeps the block cache cold so every rep measures real
+    range-request overlap, not cache hits."""
+    import threading
+    import time as _time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from disq_tpu import ReadsStorage
+    from disq_tpu.fsw import register_filesystem
+    from disq_tpu.fsw.http import HttpFileSystemWrapper
+
+    latency_s = float(os.environ.get("BENCH_HTTP_LATENCY_MS", "10")) / 1e3
+    with open(path, "rb") as f:
+        raw = f.read()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            if self.path != "/bench.bam":
+                self.send_error(404)  # e.g. the .sbi existence probe
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_GET(self):
+            if self.path != "/bench.bam":
+                self.send_error(404)
+                return
+            _time.sleep(latency_s)  # simulated remote RTT
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                lo, hi = rng[len("bytes="):].split("-")
+                lo, hi = int(lo), min(int(hi), len(raw) - 1)
+                body = raw[lo: hi + 1]
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range", f"bytes {lo}-{hi}/{len(raw)}")
+            else:
+                body = raw
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/bench.bam"
+    rows = {}
+    try:
+        for w in EXEC_WORKERS:
+            storage = (ReadsStorage.make_default()
+                       .split_size(8 * 1024 * 1024).executor_workers(w))
+
+            def run():
+                register_filesystem(
+                    "http", HttpFileSystemWrapper(block_size=1024 * 1024))
+                assert storage.read(url).count() == N_RECORDS
+
+            run()
+            med, times = _timed(run, reps)
+            rows[f"workers_{w}"] = {
+                "records_per_sec": round(N_RECORDS / med, 1),
+                "spread": _spread(times),
+            }
+        rows["simulated_rtt_ms"] = round(latency_s * 1e3, 1)
+    finally:
+        srv.shutdown()
+    return {"7_http_read_executor_scaling": rows}
+
+
 def device_inflate_config(path: str) -> dict:
     """Device-kernel row: SIMD Pallas inflate MB/s over the bench BAM's
     BGZF blocks, real chip only (skipped on CPU-only hosts)."""
@@ -366,6 +475,8 @@ def main() -> None:
         },
     }
     configs.update(secondary_configs(storage, path, tmp, max(2, REPS - 2)))
+    configs.update(executor_scaling_config(path, max(2, REPS - 2)))
+    configs.update(http_read_config(path, max(2, REPS - 2)))
     configs.update(device_inflate_config(path))
 
     print(

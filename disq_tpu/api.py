@@ -253,8 +253,20 @@ class ReadsStorage:
 
     def options(self, opts: DisqOptions) -> "ReadsStorage":
         """Replace the full read-path option set (retry budget, backoff,
-        quarantine dir) in one call."""
+        quarantine dir, executor sizing) in one call."""
         self._options = opts
+        return self
+
+    def executor_workers(self, n: int,
+                         prefetch_shards: Optional[int] = None
+                         ) -> "ReadsStorage":
+        """Size the shard-pipeline executor (``runtime/executor.py``):
+        ``n`` decode workers overlap range-reads, inflate and record
+        decode across splits; at most ``prefetch_shards`` splits run
+        ahead of the ordered emit (None ⇒ ``2 × n``). ``n=1`` (the
+        default) is the sequential-compatible inline path. Output is
+        byte-identical for any ``n``."""
+        self._options = self._options.with_executor(n, prefetch_shards)
         return self
 
     def num_shards(self, n: int) -> "ReadsStorage":
@@ -323,6 +335,15 @@ class VariantsStorage:
 
     def options(self, opts: DisqOptions) -> "VariantsStorage":
         self._options = opts
+        return self
+
+    def executor_workers(self, n: int,
+                         prefetch_shards: Optional[int] = None
+                         ) -> "VariantsStorage":
+        """Shard-pipeline executor sizing for variant reads (VCF text,
+        BGZF-split VCF, BCF block inflate) — see
+        ``ReadsStorage.executor_workers``."""
+        self._options = self._options.with_executor(n, prefetch_shards)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

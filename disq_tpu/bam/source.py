@@ -125,11 +125,20 @@ class BamSource:
         """One columnar batch per split — the unit that maps 1:1 onto
         device shards in the distributed pipeline. ``ctx`` (a
         ``ShardErrorContext``) carries the error policy; each shard gets
-        its own retrier + corrupt-block counters via ``ctx.for_shard``."""
-        import time
+        its own retrier + corrupt-block counters via ``ctx.for_shard``.
 
-        from disq_tpu.runtime import ShardCounters
+        Splits run through the shard-pipeline executor
+        (``runtime/executor.py``): stage A range-reads + walks the
+        split's compressed blocks, stage B inflates and decodes
+        records, stage C emits batches in split order — so with
+        ``DisqOptions.executor_workers > 1`` the I/O of split i+1
+        overlaps the inflate of split i while output stays
+        byte-identical to the sequential path."""
+        import functools
+
+        from disq_tpu.runtime import ShardCounters, ShardTask
         from disq_tpu.runtime.errors import context_for_storage
+        from disq_tpu.runtime.executor import executor_for_storage
 
         if ctx is None:
             ctx = context_for_storage(self._storage, path)
@@ -138,24 +147,34 @@ class BamSource:
         boundaries = self._split_boundaries(
             fs, path, header, first_voffset, splits, sbi, ctx=ctx
         )
-        out = []
-        self._last_counters = []
+        tasks = []
+        shard_ctxs = []
         for i in range(len(splits)):
             lo, hi = boundaries[i], boundaries[i + 1]
             shard_ctx = ctx.for_shard(i)
-            t0 = time.perf_counter()
-            batch, stats = shard_ctx.retrier.call(
-                self._decode_range_with_stats, fs, path, header, lo, hi,
-                ctx=shard_ctx, what=f"shard{i}",
-            )
+            shard_ctxs.append(shard_ctx)
+            tasks.append(ShardTask(
+                shard_id=i,
+                fetch=functools.partial(
+                    self._fetch_range, fs, path, lo, hi, shard_ctx),
+                decode=functools.partial(
+                    self._decode_fetched, header, ctx=shard_ctx),
+                retrier=shard_ctx.retrier,
+                what=f"shard{i}",
+            ))
+        out = []
+        self._last_counters = []
+        for res in executor_for_storage(self._storage).map_ordered(tasks):
+            batch, stats = res.value
+            shard_ctx = shard_ctxs[res.shard_id]
             self._last_counters.append(
                 ShardCounters(
-                    shard_id=i,
+                    shard_id=res.shard_id,
                     records=batch.count,
                     blocks=stats[0],
                     bytes_compressed=stats[1],
                     bytes_uncompressed=stats[2],
-                    wall_seconds=time.perf_counter() - t0,
+                    wall_seconds=res.wall_seconds,
                     skipped_blocks=shard_ctx.skipped_blocks,
                     quarantined_blocks=shard_ctx.quarantined_blocks,
                     retried_reads=shard_ctx.retrier.retried,
@@ -376,37 +395,46 @@ class BamSource:
         hi_voffset: int,
         ctx=None,
     ) -> Tuple[ReadBatch, Tuple[int, int, int]]:
-        """Decode all records whose start lies in [lo, hi) virtual space.
-
-        Reads compressed blocks from lo's block through hi's block — i.e.
-        past the split's byte-range end when a record straddles it.
-        Returns (batch, (blocks, compressed bytes, uncompressed bytes))
-        where the stats count only blocks *owned* by this range —
-        ``pos ∈ [lo_block, hi_block)`` — so a block straddling a split
-        boundary is attributed to exactly one side and reduced totals
-        match the file.
-
-        ``ctx`` (``ShardErrorContext``) governs corrupt blocks: the
-        fault-free fast path is the one batched inflate below; only when
-        it fails does the per-block salvage path run, applying the
-        policy (strict raise with coordinates / skip / quarantine).
-        """
-        from disq_tpu.runtime.errors import (
-            ErrorPolicy,
-            ShardErrorContext,
-            TruncatedReadError,
-            inflate_blocks_salvage,
-        )
+        """Decode all records whose start lies in [lo, hi) virtual space
+        — the sequential fetch+decode composition; the executor runs the
+        same two stages (``_fetch_range`` → ``_decode_fetched``) on
+        separate pools."""
+        from disq_tpu.runtime.errors import ErrorPolicy, ShardErrorContext
 
         if ctx is None:
             ctx = ShardErrorContext(policy=ErrorPolicy.STRICT, path=path)
-        # A retried attempt must not double-count the previous attempt's
-        # corrupt blocks (quarantine sidecar writes are idempotent).
+        return self._decode_fetched(
+            header,
+            self._fetch_range(fs, path, lo_voffset, hi_voffset, ctx),
+            ctx=ctx,
+        )
+
+    def _fetch_range(
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        lo_voffset: int,
+        hi_voffset: int,
+        ctx,
+    ) -> Optional[Tuple]:
+        """Stage A: range-read and walk the compressed blocks covering
+        [lo, hi) virtual space — from lo's block through hi's block,
+        i.e. past the split's byte-range end when a record straddles it.
+        Returns the staged payload for ``_decode_fetched`` (None for an
+        empty range).
+
+        ``ctx`` (``ShardErrorContext``) governs corrupt block *headers*
+        found by the salvage walk; a retried attempt resets the
+        corrupt-block counters here so the previous attempt's blocks
+        are never double-counted (quarantine sidecar writes are
+        idempotent)."""
+        from disq_tpu.runtime.errors import TruncatedReadError
+
         ctx.skipped_blocks = 0
         ctx.quarantined_blocks = 0
         if hi_voffset <= lo_voffset:
-            return ReadBatch.empty(), (0, 0, 0)
-        lo_block, lo_u = lo_voffset >> 16, lo_voffset & 0xFFFF
+            return None
+        lo_block = lo_voffset >> 16
         hi_block, hi_u = hi_voffset >> 16, hi_voffset & 0xFFFF
         length = fs.get_file_length(path)
         # Walk blocks from lo_block through hi_block (inclusive iff hi_u>0);
@@ -427,6 +455,34 @@ class BamSource:
                 fs, path, lo_block, max(want_end, lo_block + 1), length,
                 ctx, owned_until=hi_block,
             )
+        return blocks, data, gaps, lo_voffset, hi_voffset
+
+    def _decode_fetched(
+        self,
+        header: SamHeader,
+        fetched: Optional[Tuple],
+        ctx,
+    ) -> Tuple[ReadBatch, Tuple[int, int, int]]:
+        """Stage B: inflate + record-decode a staged range.
+
+        Returns (batch, (blocks, compressed bytes, uncompressed bytes))
+        where the stats count only blocks *owned* by this range —
+        ``pos ∈ [lo_block, hi_block)`` — so a block straddling a split
+        boundary is attributed to exactly one side and reduced totals
+        match the file.
+
+        ``ctx`` (``ShardErrorContext``) governs corrupt blocks: the
+        fault-free fast path is the one batched inflate below; only when
+        it fails does the per-block salvage path run, applying the
+        policy (strict raise with coordinates / skip / quarantine).
+        """
+        from disq_tpu.runtime.errors import inflate_blocks_salvage
+
+        if fetched is None:
+            return ReadBatch.empty(), (0, 0, 0)
+        blocks, data, gaps, lo_voffset, hi_voffset = fetched
+        lo_block, lo_u = lo_voffset >> 16, lo_voffset & 0xFFFF
+        hi_block, hi_u = hi_voffset >> 16, hi_voffset & 0xFFFF
         if not blocks:
             return ReadBatch.empty(), (0, 0, 0)
         # Consecutive split ranges partition [first_block, data_end) in

@@ -581,6 +581,28 @@ def encode_container(
 
         core_profile = env_flag("DISQ_TPU_CRAM_CORE")
     n = batch.count
+    # The bulk QS/RN encoders below trust the batch's flat arrays to be
+    # exactly tiled by their offsets (QS copies ``batch.quals`` whole;
+    # RN inserts NULs at ``name_offsets[1:]``). A batch whose flat
+    # arrays carry slack — offsets not starting at 0, or ending before
+    # the array does — would silently emit wrong bytes; fail loudly
+    # instead (ADVICE r5 #2).
+    if n:
+        so, no_ = batch.seq_offsets, batch.name_offsets
+        if int(so[0]) != 0 or int(so[-1]) != len(batch.seqs) \
+                or len(batch.quals) != len(batch.seqs):
+            raise ValueError(
+                "encode_container: seq_offsets must tile the flat "
+                f"seq/qual arrays exactly (offsets [{int(so[0])}, "
+                f"{int(so[-1])}], len(seqs)={len(batch.seqs)}, "
+                f"len(quals)={len(batch.quals)})"
+            )
+        if int(no_[0]) != 0 or int(no_[-1]) != len(batch.names):
+            raise ValueError(
+                "encode_container: name_offsets must tile the flat "
+                f"names array exactly (offsets [{int(no_[0])}, "
+                f"{int(no_[-1])}], len(names)={len(batch.names)})"
+            )
     streams = _Streams()
     bw = BitWriter()
     cf_codes = None

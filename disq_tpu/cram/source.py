@@ -97,27 +97,48 @@ class CramSource:
             counters.retried_reads += ctx.retrier.retried
             return ReadsDataset(header=header, reads=batch,
                                 counters=counters)
-        batches = []
-        shard_counters = []
+        # Containers run through the shard executor: stage A range-reads
+        # every container payload a split owns, stage B decodes them
+        # (rANS/gzip + record assembly — the CPU-bound phase CRAM is
+        # serialization-bound on), stage C emits per split in order.
+        import functools
+
+        from disq_tpu.runtime import ShardTask
+        from disq_tpu.runtime.executor import executor_for_storage
+
+        tasks, shard_ctxs, owned_by_shard = [], [], []
         for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
             owned = [
                 (off, hdr) for off, hdr in data_containers
                 if s.start <= off < s.end
             ]
             shard_ctx = ctx.for_shard(i)
-            records = 0
-            for off, hdr in owned:
-                b = self._decode_container_safe(fs, path, off, ref_fetch,
-                                                shard_ctx)
-                if b is not None:
-                    records += b.count
-                    batches.append(b)
+            shard_ctxs.append(shard_ctx)
+            owned_by_shard.append(owned)
+            tasks.append(ShardTask(
+                shard_id=i,
+                fetch=functools.partial(
+                    self._fetch_split_containers, fs, path, owned, shard_ctx),
+                decode=functools.partial(
+                    self._decode_split_containers, ref_fetch=ref_fetch,
+                    shard_ctx=shard_ctx),
+                retrier=shard_ctx.retrier,
+                what=f"cram-shard{i}",
+            ))
+        batches = []
+        shard_counters = []
+        for res in executor_for_storage(self._storage).map_ordered(tasks):
+            shard_batches = res.value
+            shard_ctx = shard_ctxs[res.shard_id]
+            owned = owned_by_shard[res.shard_id]
+            batches.extend(shard_batches)
             shard_counters.append(
                 ShardCounters(
-                    shard_id=i,
-                    records=records,
+                    shard_id=res.shard_id,
+                    records=sum(b.count for b in shard_batches),
                     blocks=len(owned),
                     bytes_compressed=sum(h.length for _, h in owned),
+                    wall_seconds=res.wall_seconds,
                     skipped_blocks=shard_ctx.skipped_blocks,
                     quarantined_blocks=shard_ctx.quarantined_blocks,
                     retried_reads=shard_ctx.retrier.retried,
@@ -141,45 +162,82 @@ class CramSource:
         blocks = fs.read_range(path, offset + hdr_size, hdr.length)
         return decode_container_records(blocks, ref_fetch)
 
-    def _decode_container_safe(
-        self, fs, path: str, offset: int, ref_fetch, shard_ctx
-    ) -> Optional[ReadBatch]:
-        """One container decode under the shard's error policy: transient
-        faults retry; configuration errors (missing reference) always
-        propagate; anything else is a corrupt container — strict raises
-        with coordinates, skip drops it, quarantine copies the whole
-        container (header + payload) to the sidecar."""
-        from disq_tpu.runtime.errors import (
-            ErrorPolicy,
-            MissingReferenceError,
-            is_transient,
-        )
+    def _fetch_split_containers(
+        self, fs, path: str, owned, shard_ctx
+    ) -> List[tuple]:
+        """Stage A: range-read every container payload this split owns.
+        Returns [(offset, header bytes, payload bytes), …]. Transient
+        faults propagate (the executor retries the whole shard fetch);
+        a container whose *header* no longer parses is corrupt — policy
+        applies here, and the surviving list simply omits it."""
+        from disq_tpu.runtime.errors import is_transient
 
-        try:
-            return shard_ctx.retrier.call(
-                self._decode_at, fs, path, offset, ref_fetch,
-                what=f"container@{offset}",
-            )
-        except MissingReferenceError:
-            raise
-        except Exception as e:  # noqa: BLE001 — classified below
-            if is_transient(e):
+        # A retried attempt must not double-count the previous attempt's
+        # corrupt containers (quarantine sidecar writes are idempotent).
+        shard_ctx.skipped_blocks = 0
+        shard_ctx.quarantined_blocks = 0
+        length = fs.get_file_length(path)
+        items = []
+        for off, hdr in owned:
+            try:
+                h, hdr_size = read_container_header_at(fs, path, off, length)
+                head = fs.read_range(path, off, hdr_size)
+                payload = fs.read_range(path, off + hdr_size, h.length)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if is_transient(e):
+                    raise
+                self._handle_corrupt_container(
+                    fs, path, off, hdr, b"", e, shard_ctx)
+                continue
+            items.append((off, head, payload))
+        return items
+
+    def _decode_split_containers(
+        self, items, ref_fetch, shard_ctx
+    ) -> List[ReadBatch]:
+        """Stage B: decode the staged containers under the shard's error
+        policy: configuration errors (missing reference) always
+        propagate; transient faults (the reference fetch can read)
+        propagate for the executor's refetch path; anything else is a
+        corrupt container — strict raises with coordinates, skip drops
+        it, quarantine copies the whole container (header + payload,
+        already staged — no re-fetch) to the sidecar."""
+        from disq_tpu.runtime.errors import MissingReferenceError, is_transient
+
+        batches = []
+        for off, head, payload in items:
+            try:
+                batches.append(decode_container_records(payload, ref_fetch))
+            except MissingReferenceError:
                 raise
-            raw = b""
-            if shard_ctx.policy is ErrorPolicy.QUARANTINE:
-                # Only quarantine uses the bytes — don't re-fetch a
-                # multi-MB container just to discard it under skip.
-                try:
-                    hdr, hdr_size = read_container_header_at(
-                        fs, path, offset, fs.get_file_length(path)
-                    )
-                    raw = fs.read_range(path, offset, hdr_size + hdr.length)
-                except Exception:  # noqa: BLE001 — forensics best-effort
-                    pass
-            shard_ctx.handle_corrupt_block(
-                e, block_offset=offset, raw=raw, kind="CRAM container"
-            )
-            return None
+            except Exception as e:  # noqa: BLE001 — classified below
+                if is_transient(e):
+                    raise
+                shard_ctx.handle_corrupt_block(
+                    e, block_offset=off, raw=head + payload,
+                    kind="CRAM container",
+                )
+        return batches
+
+    def _handle_corrupt_container(
+        self, fs, path: str, offset: int, hdr, raw, error, shard_ctx
+    ) -> None:
+        """Policy dispatch for a container that failed before its bytes
+        were staged: quarantine re-reads best-effort (skip/strict never
+        pay for bytes they would discard)."""
+        from disq_tpu.runtime.errors import ErrorPolicy
+
+        if shard_ctx.policy is ErrorPolicy.QUARANTINE and not raw:
+            try:
+                length = fs.get_file_length(path)
+                raw = fs.read_range(
+                    path, offset,
+                    min(hdr.length + 1024, max(0, length - offset)))
+            except Exception:  # noqa: BLE001 — forensics best-effort
+                raw = b""
+        shard_ctx.handle_corrupt_block(
+            error, block_offset=offset, raw=raw, kind="CRAM container"
+        )
 
     def _read_with_traversal(
         self, fs, path, header, ref_fetch, data_containers, traversal

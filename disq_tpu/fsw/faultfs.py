@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import io
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import BinaryIO, List, Optional, Sequence, Tuple
@@ -97,7 +98,15 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
         self.faults = list(faults)
         self.scheme = scheme
         self._rng = random.Random(seed)
-        self._calls = 0                      # matching-read counter
+        # The parallel shard executor drives reads from worker threads:
+        # the schedule's bookkeeping (call counter, per-spec matched /
+        # fired counts, RNG draws) must stay consistent — racing
+        # threads must not double-consume one call_index or skip a draw.
+        # The inner read itself runs unlocked, so injected stalls and
+        # real I/O still overlap.
+        self._mutex = threading.Lock()
+        self._pending_stall = 0.0            # booked under the mutex,
+        self._calls = 0                      # slept outside it
         self._fired: List[int] = [0] * len(self.faults)
         self._matched: List[int] = [0] * len(self.faults)
         self.injected: List[_Injection] = []
@@ -157,7 +166,7 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
                     f"[{start}, {start + length})"
                 )
             if spec.kind == "stall":
-                self._sleep(spec.stall_s)
+                self._pending_stall += spec.stall_s
             elif spec.kind == "truncate" and data:
                 data = data[: max(0, len(data) - spec.truncate_bytes)]
             elif spec.kind == "bitflip" and data:
@@ -172,13 +181,20 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
 
     def read_range(self, path: str, start: int, length: int) -> bytes:
         real = self._strip(path)
-        self._calls += 1
-        call = self._calls
         # Pre-read faults raise/stall; the matched-call and RNG state
         # advance exactly once per attempt, so a retry is a NEW draw.
-        self._apply_faults(real, start, length, None, call)
+        with self._mutex:
+            self._calls += 1
+            call = self._calls
+            self._apply_faults(real, start, length, None, call)
+            stall, self._pending_stall = self._pending_stall, 0.0
+        if stall:
+            # Injected latency must not serialize concurrent readers:
+            # sleep outside the schedule mutex.
+            self._sleep(stall)
         data = self.inner.read_range(real, start, length)
-        return self._apply_faults(real, start, length, data, call)
+        with self._mutex:
+            return self._apply_faults(real, start, length, data, call)
 
     def open(self, path: str) -> BinaryIO:
         # Route stream reads through read_range so every byte a caller

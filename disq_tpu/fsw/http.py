@@ -126,34 +126,63 @@ class HttpFileSystemWrapper(FileSystemWrapper):
                 with self._lock:
                     self.stats.retries += retrier.retried
 
+    # A server that ignores Range replies 200 with the body from byte 0;
+    # bytes up to ``end_incl`` must be read regardless (HTTP streams
+    # can't seek) but everything past it is pure slack — read at most
+    # this many blocks of it (they seed the cache), then abandon the
+    # connection instead of buffering a possibly-multi-GB object on
+    # every attempt.
+    _FULL_READ_SLACK_BLOCKS = 32
+
     def _fetch(self, url: str, start: int, end_incl: int) -> bytes:
         """One ranged GET via ``_retrying``. A server ignoring Range
-        (200 with the whole object) is sliced, accounted at its REAL
-        transfer size, and seeds the block cache so a scan doesn't
-        re-download the object per block."""
+        (200 with the whole object) is stream-read to a bounded prefix
+        — the requested range plus ``_FULL_READ_SLACK_BLOCKS`` blocks —
+        sliced, accounted at its REAL transfer size, and seeds the
+        block cache so a scan doesn't re-download the object per
+        block."""
         def ranged_get():
             req = urllib.request.Request(
                 url, headers={"Range": f"bytes={start}-{end_incl}"})
             with urllib.request.urlopen(
                     req, timeout=self._TIMEOUT_S) as resp:
-                body = resp.read()
-                return body, (body if resp.status == 200 else None)
+                if resp.status != 200:  # 206: the server honored Range
+                    return resp.read(), None
+                cap = (end_incl + 1
+                       + self._FULL_READ_SLACK_BLOCKS * self.block_size)
+                chunks: List[bytes] = []
+                got = 0
+                while got < cap:
+                    chunk = resp.read(min(1 << 20, cap - got))
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                    got += len(chunk)
+                full = b"".join(chunks)
+                return full[start: end_incl + 1], full
 
         data, full = self._retrying(ranged_get)
         if full is not None:
-            data = full[start: end_incl + 1]
             bs = self.block_size
             want = start // bs
+            total = self._lengths.get(url)
             with self._lock:
                 self.stats.range_requests += 1
                 self.stats.bytes_fetched += len(full)
                 for bi in range((len(full) + bs - 1) // bs):
-                    if bi != want:
-                        self._cache_put(
-                            (url, bi), full[bi * bs: (bi + 1) * bs])
+                    blk = full[bi * bs: (bi + 1) * bs]
+                    # Only complete blocks may seed the cache: the
+                    # capped prefix can end mid-block, and a short
+                    # cached block would silently truncate later reads.
+                    complete = len(blk) == bs or (
+                        total is not None and (bi + 1) * bs >= total)
+                    if bi != want and complete:
+                        self._cache_put((url, bi), blk)
                 # the requested block last, so LRU keeps it
-                self._cache_put(
-                    (url, want), full[want * bs: (want + 1) * bs])
+                want_blk = full[want * bs: (want + 1) * bs]
+                if len(want_blk) == bs or (
+                        total is not None and (want + 1) * bs >= total):
+                    self._cache_put((url, want), want_blk)
         else:
             with self._lock:
                 self.stats.range_requests += 1

@@ -105,7 +105,8 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.disq_segment_gather.restype = ctypes.c_int64
     lib.disq_segment_gather.argtypes = [
-        u8p, i64p, i64p, ctypes.c_int64, i64p, u8p, ctypes.c_int64,
+        u8p, ctypes.c_int64, i64p, ctypes.c_int64, i64p, ctypes.c_int64,
+        i64p, u8p, ctypes.c_int64,
     ]
 
 
@@ -431,17 +432,32 @@ def segment_gather_native(
     if len(indices) and int(indices.min()) < 0:
         # numpy negative-index semantics; the C loop needs them absolute
         indices = np.where(indices < 0, indices + nseg, indices)
+    flat_c = np.ascontiguousarray(flat)
+    # Mirror of the native-side validation (ADVICE r5 #1): a
+    # non-monotone offsets table would turn into a negative length —
+    # which the old C loop cast to a huge size_t OOB memcpy — and an
+    # offsets[-1] past the flat buffer would read beyond it.
+    if nseg > 0:
+        if int(offsets[0]) < 0 or np.any(np.diff(offsets) < 0):
+            raise ValueError(
+                "segment_gather: offsets must be non-negative and "
+                "monotone non-decreasing")
+        if int(offsets[-1]) > len(flat_c):
+            raise ValueError(
+                f"segment_gather: offsets[-1]={int(offsets[-1])} exceeds "
+                f"flat length {len(flat_c)}")
     lens = np.diff(offsets)[indices]
     new_off = np.zeros(len(indices) + 1, dtype=np.int64)
     np.cumsum(lens, out=new_off[1:])
-    flat_c = np.ascontiguousarray(flat)
     out = np.empty(int(new_off[-1]), dtype=flat_c.dtype)
-    lib.disq_segment_gather(
-        _ptr(flat_c.view(np.uint8), ctypes.c_uint8),
-        _ptr(offsets, ctypes.c_int64),
+    rc = lib.disq_segment_gather(
+        _ptr(flat_c.view(np.uint8), ctypes.c_uint8), len(flat_c),
+        _ptr(offsets, ctypes.c_int64), nseg,
         _ptr(indices, ctypes.c_int64), len(indices),
         _ptr(new_off, ctypes.c_int64),
         _ptr(out.view(np.uint8), ctypes.c_uint8),
         flat_c.dtype.itemsize,
     )
+    if rc != 0:
+        raise ValueError(f"segment_gather failed validation (code {rc})")
     return out, new_off

@@ -13,6 +13,10 @@ protocol. JAX has no task retry, so the equivalents here are:
   (strict/skip/quarantine dispatch of corrupt blocks), and
   ``CorruptBlockError`` with full (path, shard, block, voffset)
   coordinates.
+- ``executor`` — the shard-pipeline executor: a bounded three-stage
+  fetch → decode → ordered-emit pipeline shared by every format
+  source, overlapping range-reads, inflate and record decode across
+  splits (``DisqOptions.executor_workers`` / ``prefetch_shards``).
 - ``counters`` — per-shard counters (records, blocks, bytes,
   compression ratio) returned per shard and reduced.
 - ``tracing`` — phase wrappers around ``jax.profiler`` traces plus
@@ -39,11 +43,23 @@ from disq_tpu.runtime.errors import (  # noqa: F401
     context_for_storage,
     is_transient,
 )
+from disq_tpu.runtime.executor import (  # noqa: F401
+    ExecutorStats,
+    ShardPipelineExecutor,
+    ShardResult,
+    ShardTask,
+    executor_for_storage,
+)
 from disq_tpu.runtime.manifest import (  # noqa: F401
     QuarantineManifest,
     StageManifest,
 )
-from disq_tpu.runtime.tracing import trace_phase, phase_report  # noqa: F401
+from disq_tpu.runtime.tracing import (  # noqa: F401
+    gauge_report,
+    observe_gauge,
+    phase_report,
+    trace_phase,
+)
 from disq_tpu.runtime.debug import (  # noqa: F401
     debug_enabled,
     check_read_batch,

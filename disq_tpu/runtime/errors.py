@@ -31,6 +31,7 @@ reads are transient; ``ValueError``-family codec errors are corrupt.
 from __future__ import annotations
 
 import enum
+import threading
 import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Optional, TypeVar
@@ -66,15 +67,31 @@ class DisqOptions:
     ``quarantine_dir`` defaults to ``<input path> + ".quarantine"`` on
     the local filesystem; remote (read-only) inputs must set it
     explicitly.
+
+    ``executor_workers`` / ``prefetch_shards`` size the shard-pipeline
+    executor (``runtime/executor.py``): 1 worker (the default) is the
+    sequential-compatible inline path; N>1 overlaps range-reads,
+    inflate and record decode across splits with at most
+    ``prefetch_shards`` splits in flight past the emit frontier
+    (None ⇒ ``2 × executor_workers``).
     """
 
     error_policy: ErrorPolicy = ErrorPolicy.STRICT
     max_retries: int = 3
     retry_backoff_s: float = 0.05
     quarantine_dir: Optional[str] = None
+    executor_workers: int = 1
+    prefetch_shards: Optional[int] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
+
+    def with_executor(self, workers: int,
+                      prefetch_shards: Optional[int] = None) -> "DisqOptions":
+        if workers < 1:
+            raise ValueError(f"executor_workers must be >= 1, got {workers}")
+        return replace(self, executor_workers=int(workers),
+                       prefetch_shards=prefetch_shards)
 
 
 class CorruptBlockError(ValueError):
@@ -284,14 +301,20 @@ class ShardErrorContext:
             policy=ErrorPolicy.SKIP, path=self.path, shard_id=self.shard_id
         )
 
+    # Sink creation races under the parallel shard executor: two shards
+    # hitting their first corrupt block concurrently must share ONE
+    # manifest (two instances would tear the JSONL ledger header).
+    _sink_lock = threading.Lock()
+
     def _quarantine_sink(self) -> "QuarantineManifest":  # noqa: F821
         if self.quarantine is None:
             from disq_tpu.runtime.manifest import QuarantineManifest
 
             parent = getattr(self, "_parent", None)
-            if parent is not None and parent.quarantine is not None:
-                self.quarantine = parent.quarantine
-            else:
+            with ShardErrorContext._sink_lock:
+                if parent is not None and parent.quarantine is not None:
+                    self.quarantine = parent.quarantine
+                    return self.quarantine
                 base = self.quarantine_dir
                 if base is None:
                     if "://" in self.path:

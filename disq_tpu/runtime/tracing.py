@@ -27,6 +27,7 @@ logger = logging.getLogger("disq_tpu.tracing")
 
 _lock = threading.Lock()
 _phases: List[Tuple[str, float]] = []
+_gauges: Dict[str, Dict[str, float]] = {}
 _trace_active = False
 
 
@@ -81,6 +82,16 @@ def trace_phase(name: str) -> Iterator[None]:
         logger.debug("phase %s: %.4fs", name, dt)
 
 
+def record_phase(name: str, seconds: float) -> None:
+    """Book an already-measured duration as a phase (for waits that are
+    timed inline — e.g. the executor's ordered-emit stall — where
+    wrapping the wait in ``trace_phase`` would nest a lock inside a
+    condition wait)."""
+    with _lock:
+        _phases.append((name, seconds))
+    logger.debug("phase %s: %.4fs", name, seconds)
+
+
 def phase_report() -> Dict[str, Dict[str, float]]:
     """Aggregated {phase: {calls, total_s}} since process start."""
     out: Dict[str, Dict[str, float]] = {}
@@ -98,3 +109,29 @@ def phase_report() -> Dict[str, Dict[str, float]]:
 def reset_phase_report() -> None:
     with _lock:
         _phases.clear()
+
+
+def observe_gauge(name: str, value: float) -> None:
+    """Record one sample of a level-style quantity (queue depth,
+    in-flight shard count): the report keeps max / last / sample
+    count rather than a sum — gauges are states, not durations."""
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            _gauges[name] = {"max": value, "last": value, "samples": 1}
+        else:
+            g["max"] = max(g["max"], value)
+            g["last"] = value
+            g["samples"] += 1
+
+
+def gauge_report() -> Dict[str, Dict[str, float]]:
+    """Snapshot of every gauge observed since process start (or the
+    last ``reset_gauges``)."""
+    with _lock:
+        return {k: dict(v) for k, v in _gauges.items()}
+
+
+def reset_gauges() -> None:
+    with _lock:
+        _gauges.clear()

@@ -76,20 +76,46 @@ class VcfSource:
     # -- plain text ---------------------------------------------------------
 
     def _read_plain(self, fs, path, header, ctx=None) -> VariantBatch:
-        batches = []
+        """Byte-range line splits through the shard executor: stage A
+        reads + line-resolves a split, stage B parses its lines into a
+        columnar batch, stage C concatenates in split order."""
+        import functools
+
+        tasks, shard_ctxs = [], []
         for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
             shard_ctx = ctx.for_shard(i) if ctx is not None else None
-            lines = (
-                shard_ctx.retrier.call(
-                    lines_for_split, fs, path, s.start, s.end,
-                    what=f"split{i}")
-                if shard_ctx is not None
-                else lines_for_split(fs, path, s.start, s.end)
-            )
+            shard_ctxs.append(shard_ctx)
+            tasks.append(self._make_task(
+                i, shard_ctx,
+                functools.partial(lines_for_split, fs, path, s.start, s.end),
+                header,
+            ))
+        return self._emit_batches(tasks, shard_ctxs, header)
+
+    def _make_task(self, shard_id, shard_ctx, fetch, header):
+        from disq_tpu.runtime import ShardTask
+
+        def decode(lines):
             raw = [ln for ln in lines if ln and not ln.startswith(b"#")]
-            batches.append(parse_vcf_lines(raw, header.contig_names))
-            self._track(shard_ctx, i, batches[-1])
-        return VariantBatch.concat(batches) if batches else VariantBatch.empty(header.contig_names)
+            return parse_vcf_lines(raw, header.contig_names)
+
+        return ShardTask(
+            shard_id=shard_id,
+            fetch=fetch,
+            decode=decode,
+            retrier=shard_ctx.retrier if shard_ctx is not None else None,
+            what=f"split{shard_id}",
+        )
+
+    def _emit_batches(self, tasks, shard_ctxs, header) -> VariantBatch:
+        from disq_tpu.runtime.executor import executor_for_storage
+
+        batches = []
+        for res in executor_for_storage(self._storage).map_ordered(tasks):
+            batches.append(res.value)
+            self._track(shard_ctxs[res.shard_id], res.shard_id, res.value)
+        return (VariantBatch.concat(batches) if batches
+                else VariantBatch.empty(header.contig_names))
 
     def _track(self, shard_ctx, shard_id: int, batch) -> None:
         from disq_tpu.runtime import ShardCounters
@@ -119,21 +145,25 @@ class VcfSource:
     # -- splittable bgzf ----------------------------------------------------
 
     def _read_bgzf(self, fs, path, header, ctx=None) -> VariantBatch:
+        """Block-aligned splittable read through the shard executor:
+        stage A walks + inflates the split's blocks into owned lines
+        (I/O-dominated — the BGZF walk, the straddling-line extension
+        and the inflate all read through the fsw layer), stage B parses
+        lines columnar, stage C concatenates in split order."""
+        import functools
+
         length = fs.get_file_length(path)
-        batches = []
+        tasks, shard_ctxs = [], []
         for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
             shard_ctx = ctx.for_shard(i) if ctx is not None else None
-            if shard_ctx is not None:
-                raw = shard_ctx.retrier.call(
-                    self._bgzf_split_lines, fs, path, s.start, s.end,
-                    length, ctx=shard_ctx, what=f"split{i}",
-                )
-            else:
-                raw = self._bgzf_split_lines(fs, path, s.start, s.end, length)
-            raw = [ln for ln in raw if ln and not ln.startswith(b"#")]
-            batches.append(parse_vcf_lines(raw, header.contig_names))
-            self._track(shard_ctx, i, batches[-1])
-        return VariantBatch.concat(batches) if batches else VariantBatch.empty(header.contig_names)
+            shard_ctxs.append(shard_ctx)
+            tasks.append(self._make_task(
+                i, shard_ctx,
+                functools.partial(self._bgzf_split_lines, fs, path,
+                                  s.start, s.end, length, ctx=shard_ctx),
+                header,
+            ))
+        return self._emit_batches(tasks, shard_ctxs, header)
 
     def _inflate_with_gaps(self, data, blocks, gaps, base: int, ctx):
         """``_inflate_with_policy`` when the block walk itself needed

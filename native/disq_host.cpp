@@ -819,12 +819,25 @@ int64_t disq_rans_decode(const uint8_t* data, int64_t len, uint8_t* out,
 // `elem` bytes). The caller computes new_off as the cumsum of gathered
 // lengths; per-segment memcpy beats numpy's repeat/arange/fancy-index
 // construction ~10x on the sort permute path (bam/columnar.py).
-int64_t disq_segment_gather(const uint8_t* flat, const int64_t* offsets,
+//
+// The offsets table is validated BEFORE the memcpy loop: a
+// non-monotone entry would compute a negative length that casts to a
+// huge size_t (an OOB copy), and an offsets[-1] past the flat buffer
+// would read beyond it. Returns 0 on success, -1 for an index out of
+// [0, nseg), -2 for a negative/non-monotone offsets table, -3 when
+// offsets overrun flat_elems.
+int64_t disq_segment_gather(const uint8_t* flat, int64_t flat_elems,
+                            const int64_t* offsets, int64_t nseg,
                             const int64_t* indices, int64_t n,
                             const int64_t* new_off, uint8_t* out,
                             int64_t elem) {
+  if (nseg < 0 || (nseg >= 0 && offsets[0] < 0)) return -2;
+  for (int64_t s = 0; s < nseg; s++)
+    if (offsets[s + 1] < offsets[s]) return -2;
+  if (offsets[nseg] > flat_elems) return -3;
   for (int64_t i = 0; i < n; i++) {
     int64_t s = indices[i];
+    if (s < 0 || s >= nseg) return -1;
     int64_t len = (offsets[s + 1] - offsets[s]) * elem;
     if (len)
       memcpy(out + new_off[i] * elem, flat + offsets[s] * elem,

@@ -79,7 +79,8 @@ def pick_block(data: bytes, rng: random.Random) -> int:
     return layout[rng.randint(1, max(1, len(layout) - 2))]
 
 
-def run_iteration(path, data, n_records, baseline, it_seed: int) -> str:
+def run_iteration(path, data, n_records, baseline, it_seed: int,
+                  executor_workers: int = 1) -> str:
     """One soak iteration; returns "" on success, else a description."""
     import numpy as np
 
@@ -117,6 +118,7 @@ def run_iteration(path, data, n_records, baseline, it_seed: int) -> str:
             policy if policy != "recover" else "strict"),
         max_retries=6, retry_backoff_s=0.0,
         quarantine_dir=path + f".quarantine-{it_seed}",
+        executor_workers=executor_workers,
     )
     storage = ReadsStorage.make_default().split_size(SPLIT).options(opts)
 
@@ -158,6 +160,12 @@ def main(argv=None) -> int:
     ap.add_argument("--records", type=int, default=400)
     ap.add_argument("--seed", type=int, default=0,
                     help="master seed; each iteration derives its own")
+    ap.add_argument("--executor-workers", type=int, default=1,
+                    help="shard-pipeline executor width: >1 soaks the "
+                         "parallel read path (fault firing order becomes "
+                         "thread-dependent, but the recovery contract — "
+                         "byte identity / bounded loss / strict raise — "
+                         "must hold regardless)")
     args = ap.parse_args(argv)
 
     from disq_tpu import ReadsStorage
@@ -168,7 +176,8 @@ def main(argv=None) -> int:
         failures = []
         for i in range(args.iterations):
             it_seed = args.seed * 1_000_003 + i
-            err = run_iteration(path, data, n_records, baseline, it_seed)
+            err = run_iteration(path, data, n_records, baseline, it_seed,
+                                executor_workers=args.executor_workers)
             status = "ok" if not err else f"FAIL: {err}"
             print(f"[{i + 1}/{args.iterations}] seed={it_seed} {status}")
             if err:

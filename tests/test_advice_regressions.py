@@ -231,3 +231,45 @@ class TestRansTruncatedStreams:
         )
         with pytest.raises(ValueError, match="2\\^31"):
             rans0_decode_device([bytes(stream)], interpret=True)
+
+
+class TestEncodeContainerSlackRejected:
+    """ADVICE r5 #2: the bulk QS/RN encoders in ``encode_container``
+    copy the batch's flat qual/name arrays verbatim — a batch whose
+    offsets don't tile those arrays exactly (slack at either end) used
+    to emit silently wrong bytes; it must error instead."""
+
+    def _sliced_views_ok(self):
+        # sanity: ReadBatch.slice rebases offsets, so normal sink
+        # slicing passes the validation
+        from disq_tpu.cram.codec import encode_container
+
+        b = _batch(50).slice(10, 40)
+        container, _ = encode_container(b, int(b.refid[0]), 0)
+        assert container
+
+    def test_slack_in_flat_arrays_rejected(self):
+        import dataclasses
+
+        import numpy as np
+
+        from disq_tpu.cram.codec import encode_container
+
+        self._sliced_views_ok()
+        b = _batch(30)
+        # append slack bytes to the flat arrays without touching offsets
+        bad = dataclasses.replace(
+            b,
+            seqs=np.concatenate([b.seqs, np.zeros(7, np.uint8)]),
+            quals=np.concatenate([b.quals, np.zeros(7, np.uint8)]),
+        )
+        with pytest.raises(ValueError, match="seq_offsets"):
+            encode_container(bad, int(bad.refid[0]), 0)
+        bad = dataclasses.replace(
+            b, names=np.concatenate([b.names, np.zeros(3, np.uint8)]))
+        with pytest.raises(ValueError, match="name_offsets"):
+            encode_container(bad, int(bad.refid[0]), 0)
+        # quals shorter than seqs (per-record lengths must agree)
+        bad = dataclasses.replace(b, quals=b.quals[:-1])
+        with pytest.raises(ValueError, match="seq_offsets"):
+            encode_container(bad, int(bad.refid[0]), 0)

@@ -1,0 +1,392 @@
+"""Shard-pipeline executor: unit behavior (ordering, bounded window,
+error propagation) and the cross-format determinism contract — with
+``executor_workers`` in {1, 2, 8}, records, counters and written bytes
+must be identical to the sequential path, including under injected
+faults (transient blips + a corrupt block mid-stream)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bam_oracle import (
+    DEFAULT_REFS,
+    make_bam_bytes,
+    o_bgzf_compress,
+    synth_records,
+)
+from disq_tpu import ReadsStorage, VariantsStorage
+from disq_tpu.runtime.executor import (
+    ShardPipelineExecutor,
+    ShardTask,
+    executor_for_storage,
+)
+
+WORKER_COUNTS = [1, 2, 8]
+
+
+# ---------------------------------------------------------------------------
+# unit: the executor itself
+
+
+class TestExecutorUnit:
+    def _tasks(self, n, fetch_log=None, decode_log=None, sleep=0.0):
+        def mk(i):
+            def fetch():
+                if sleep:
+                    time.sleep(sleep)
+                if fetch_log is not None:
+                    fetch_log.append(i)
+                return i * 10
+
+            def decode(payload):
+                if decode_log is not None:
+                    decode_log.append(i)
+                return payload + 1
+
+            return ShardTask(shard_id=i, fetch=fetch, decode=decode)
+
+        return [mk(i) for i in range(n)]
+
+    @pytest.mark.parametrize("workers", WORKER_COUNTS)
+    def test_ordered_results(self, workers):
+        ex = ShardPipelineExecutor(workers=workers)
+        results = list(ex.map_ordered(self._tasks(23, sleep=0.001)))
+        assert [r.shard_id for r in results] == list(range(23))
+        assert [r.value for r in results] == [i * 10 + 1 for i in range(23)]
+
+    def test_empty_tasks(self):
+        assert list(ShardPipelineExecutor(workers=4).map_ordered([])) == []
+
+    def test_sequential_runs_inline_in_order(self):
+        log = []
+        ex = ShardPipelineExecutor(workers=1)
+        for res in ex.map_ordered(self._tasks(5, fetch_log=log)):
+            # workers=1 is the inline path: shard i+1's fetch must not
+            # have started before shard i was emitted
+            assert log == list(range(res.shard_id + 1))
+
+    def test_bounded_in_flight_window(self):
+        ex = ShardPipelineExecutor(workers=2, prefetch_shards=3)
+        release = threading.Event()
+
+        def mk(i):
+            def fetch():
+                if i == 0:
+                    release.wait(timeout=30)
+                return i
+
+            return ShardTask(shard_id=i, fetch=fetch, decode=lambda p: p)
+
+        tasks = [mk(i) for i in range(12)]
+        it = iter(ex.map_ordered(tasks))
+        # shard 0 stalls in fetch; the window admits only window-many
+        time.sleep(0.2)
+        assert ex.stats.max_in_flight <= ex.stats.window
+        release.set()
+        out = [r.value for r in it]
+        assert out == list(range(12))
+        # everything ran despite the stall, within the bounded window
+        assert ex.stats.shards == 12
+
+    def test_stalled_shard_does_not_block_window_peers(self):
+        """While shard 0 is stalled, shards inside the window must keep
+        decoding (overlap, not head-of-line blocking)."""
+        ex = ShardPipelineExecutor(workers=2, prefetch_shards=4)
+        release = threading.Event()
+        decoded = []
+
+        def mk(i):
+            def fetch():
+                if i == 0:
+                    release.wait(timeout=30)
+                return i
+
+            def decode(p):
+                decoded.append(i)
+                return p
+
+            return ShardTask(shard_id=i, fetch=fetch, decode=decode)
+
+        it = iter(ex.map_ordered([mk(i) for i in range(6)]))
+        deadline = time.time() + 10
+        while len([d for d in decoded if d != 0]) < 2:
+            assert time.time() < deadline, "no overlap while shard 0 stalled"
+            time.sleep(0.01)
+        release.set()
+        assert [r.shard_id for r in it] == list(range(6))
+
+    @pytest.mark.parametrize("workers", [1, 4])
+    def test_error_propagates(self, workers):
+        def boom(_):
+            raise ValueError("decode broke")
+
+        tasks = [ShardTask(shard_id=0, fetch=lambda: 1, decode=lambda p: p),
+                 ShardTask(shard_id=1, fetch=lambda: 1, decode=boom)]
+        ex = ShardPipelineExecutor(workers=workers)
+        it = ex.map_ordered(tasks)
+        assert next(it).shard_id == 0
+        with pytest.raises(ValueError, match="decode broke"):
+            list(it)
+
+    @pytest.mark.parametrize("workers", [1, 4])
+    def test_transient_fetch_retried(self, workers):
+        from disq_tpu.runtime.errors import ShardRetrier, TransientIOError
+
+        fails = {"n": 2}
+
+        def fetch():
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise TransientIOError("blip")
+            return 7
+
+        retrier = ShardRetrier(max_retries=4, backoff_s=0.0)
+        tasks = [ShardTask(shard_id=0, fetch=fetch, decode=lambda p: p,
+                           retrier=retrier)]
+        out = list(ShardPipelineExecutor(workers=workers).map_ordered(tasks))
+        assert out[0].value == 7
+        assert retrier.retried == 2
+
+    def test_transient_decode_reruns_from_fetch(self):
+        from disq_tpu.runtime.errors import ShardRetrier, TransientIOError
+
+        fetched, failed = [], {"n": 1}
+
+        def fetch():
+            fetched.append(1)
+            return len(fetched)
+
+        def decode(p):
+            if failed["n"] > 0:
+                failed["n"] -= 1
+                raise TransientIOError("mid-decode blip")
+            return p
+
+        retrier = ShardRetrier(max_retries=3, backoff_s=0.0)
+        tasks = [ShardTask(shard_id=0, fetch=fetch, decode=decode,
+                           retrier=retrier)]
+        out = list(ShardPipelineExecutor(workers=2).map_ordered(tasks))
+        assert out[0].value == 2          # decoded the re-fetched payload
+        assert len(fetched) == 2          # rerun came from stage A
+        assert retrier.retried >= 1
+
+    def test_executor_for_storage_defaults(self):
+        ex = executor_for_storage(ReadsStorage.make_default())
+        assert ex.workers == 1
+        ex = executor_for_storage(
+            ReadsStorage.make_default().executor_workers(6, 9))
+        assert ex.workers == 6 and ex.prefetch_shards == 9
+
+    def test_invalid_worker_count_rejected(self):
+        with pytest.raises(ValueError, match="executor_workers"):
+            ReadsStorage.make_default().executor_workers(0)
+
+
+# ---------------------------------------------------------------------------
+# determinism across formats
+
+
+COUNTER_KEYS = ("shards", "records", "blocks", "bytes_compressed",
+                "bytes_uncompressed", "skipped_blocks", "quarantined_blocks")
+
+
+def _counters_equal(a, b):
+    da, db = a.as_dict(), b.as_dict()
+    return {k: da[k] for k in COUNTER_KEYS} == {k: db[k] for k in COUNTER_KEYS}
+
+
+@pytest.fixture(scope="module")
+def bam_file(tmp_path_factory):
+    raw = make_bam_bytes(DEFAULT_REFS, synth_records(2200, seed=11),
+                         blocksize=600)
+    p = tmp_path_factory.mktemp("exec") / "d.bam"
+    p.write_bytes(raw)
+    return str(p)
+
+
+class TestDeterminismAcrossWorkers:
+    @pytest.mark.parametrize("workers", [2, 8])
+    def test_bam_identical(self, bam_file, workers, tmp_path):
+        base_st = ReadsStorage.make_default().split_size(4096)
+        base = base_st.read(bam_file)
+        st = (ReadsStorage.make_default().split_size(4096)
+              .executor_workers(workers))
+        ds = st.read(bam_file)
+        assert ds.count() == base.count()
+        np.testing.assert_array_equal(ds.reads.pos, base.reads.pos)
+        np.testing.assert_array_equal(ds.reads.names, base.reads.names)
+        np.testing.assert_array_equal(ds.reads.seqs, base.reads.seqs)
+        np.testing.assert_array_equal(ds.reads.tags, base.reads.tags)
+        assert _counters_equal(ds.counters, base.counters)
+        # written bytes are byte-identical too
+        out_a = tmp_path / "a.bam"
+        out_b = tmp_path / "b.bam"
+        base_st.write(base, str(out_a))
+        st.write(ds, str(out_b))
+        assert out_a.read_bytes() == out_b.read_bytes()
+
+    @pytest.mark.parametrize("workers", [2, 8])
+    def test_vcf_bgzf_identical(self, tmp_path, workers):
+        header = ("##fileformat=VCFv4.3\n"
+                  "##contig=<ID=chr1,length=248956422>\n"
+                  '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+                  "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        body = "".join(
+            f"chr1\t{100 + i * 3}\t.\tA\tG\t50\tPASS\tDP={i % 40}\n"
+            for i in range(3000))
+        p = tmp_path / "v.vcf.bgz"
+        p.write_bytes(o_bgzf_compress((header + body).encode(),
+                                      blocksize=777))
+        base = VariantsStorage.make_default().split_size(4096).read(str(p))
+        ds = (VariantsStorage.make_default().split_size(4096)
+              .executor_workers(workers).read(str(p)))
+        assert ds.count() == base.count() == 3000
+        np.testing.assert_array_equal(ds.variants.pos, base.variants.pos)
+        np.testing.assert_array_equal(ds.variants.lines, base.variants.lines)
+        assert _counters_equal(ds.counters, base.counters)
+
+    @pytest.mark.parametrize("workers", [2, 8])
+    def test_cram_identical(self, bam_file, tmp_path, workers):
+        st = ReadsStorage.make_default()
+        cram = tmp_path / "d.cram"
+        st.write(st.read(bam_file).coordinate_sorted(), str(cram))
+        base = ReadsStorage.make_default().split_size(8192).read(str(cram))
+        ds = (ReadsStorage.make_default().split_size(8192)
+              .executor_workers(workers).read(str(cram)))
+        assert ds.count() == base.count()
+        np.testing.assert_array_equal(ds.reads.pos, base.reads.pos)
+        np.testing.assert_array_equal(ds.reads.names, base.reads.names)
+        assert _counters_equal(ds.counters, base.counters)
+
+    @pytest.mark.parametrize("workers", [2, 8])
+    def test_bcf_identical(self, tmp_path, workers):
+        from disq_tpu.api import VariantsDataset
+        from disq_tpu.vcf.columnar import parse_vcf_lines
+        from disq_tpu.vcf.header import VcfHeader
+
+        header = ("##fileformat=VCFv4.3\n"
+                  "##contig=<ID=chr1,length=248956422>\n"
+                  '##INFO=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+                  "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        lines = [f"chr1\t{10 + 5 * i}\t.\tA\tG\t50\tPASS\tDP={i % 9}"
+                 for i in range(2500)]
+        h = VcfHeader.from_text(header)
+        batch = parse_vcf_lines([l.encode() for l in lines], h.contig_names)
+        p = tmp_path / "d.bcf"
+        VariantsStorage.make_default().write(
+            VariantsDataset(header=h, variants=batch), str(p))
+        base = VariantsStorage.make_default().split_size(2048).read(str(p))
+        ds = (VariantsStorage.make_default().split_size(2048)
+              .executor_workers(workers).read(str(p)))
+        assert ds.count() == base.count() == 2500
+        np.testing.assert_array_equal(ds.variants.pos, base.variants.pos)
+
+
+# ---------------------------------------------------------------------------
+# fault interplay
+
+
+class TestFaultInterplay:
+    """Skip/quarantine bookkeeping under executor_workers=8 must match
+    the sequential path: a deterministic bit flip drops exactly the
+    same block, transient blips are absorbed, and strict still raises
+    with the corrupt block's coordinates."""
+
+    def _fault_read(self, bam_path, raw, policy, workers, faults, seed,
+                    quarantine_dir=None):
+        from disq_tpu import DisqOptions, ErrorPolicy
+        from disq_tpu.fsw import (
+            FaultInjectingFileSystemWrapper,
+            PosixFileSystemWrapper,
+            register_filesystem,
+        )
+
+        fsw = FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(), faults, seed=seed)
+        register_filesystem("fault", fsw)
+        opts = DisqOptions(
+            error_policy=ErrorPolicy.coerce(policy), max_retries=8,
+            retry_backoff_s=0.0, quarantine_dir=quarantine_dir,
+            executor_workers=workers,
+        )
+        st = ReadsStorage.make_default().split_size(4096).options(opts)
+        return st.read("fault://" + bam_path)
+
+    @staticmethod
+    def _block_offset(raw, k):
+        """File offset of the k-th BGZF block."""
+        from disq_tpu.bgzf.block import parse_block_header
+
+        pos = 0
+        for _ in range(k):
+            pos += parse_block_header(raw, pos)
+        return pos
+
+    def test_skip_matches_sequential(self, bam_file):
+        from disq_tpu.fsw import FaultSpec
+
+        raw = open(bam_file, "rb").read()
+        corrupt_at = self._block_offset(raw, 9)
+        faults = [
+            FaultSpec(kind="bitflip", offset=corrupt_at + 20, bit=3),
+            FaultSpec(kind="transient", probability=0.03),
+        ]
+        seq = self._fault_read(bam_file, raw, "skip", 1, faults, seed=5)
+        par = self._fault_read(bam_file, raw, "skip", 8, faults, seed=5)
+        assert par.count() == seq.count()
+        np.testing.assert_array_equal(par.reads.pos, seq.reads.pos)
+        np.testing.assert_array_equal(par.reads.names, seq.reads.names)
+        assert par.counters.skipped_blocks == \
+            seq.counters.skipped_blocks == 1
+        assert par.counters.quarantined_blocks == 0
+
+    def test_quarantine_matches_sequential(self, bam_file, tmp_path):
+        from disq_tpu.fsw import FaultSpec
+
+        raw = open(bam_file, "rb").read()
+        corrupt_at = self._block_offset(raw, 7)
+        faults = [FaultSpec(kind="bitflip", offset=corrupt_at + 20, bit=1),
+                  FaultSpec(kind="transient", probability=0.02)]
+        qdir_seq = str(tmp_path / "q-seq")
+        qdir_par = str(tmp_path / "q-par")
+        seq = self._fault_read(bam_file, raw, "quarantine", 1, faults,
+                               seed=3, quarantine_dir=qdir_seq)
+        par = self._fault_read(bam_file, raw, "quarantine", 8, faults,
+                               seed=3, quarantine_dir=qdir_par)
+        assert par.count() == seq.count()
+        assert par.counters.quarantined_blocks == \
+            seq.counters.quarantined_blocks == 1
+        # the same sidecar block bytes were set aside by both paths
+        seq_bins = sorted(f for f in os.listdir(qdir_seq)
+                          if f.startswith("block-"))
+        par_bins = sorted(f for f in os.listdir(qdir_par)
+                          if f.startswith("block-"))
+        assert seq_bins == par_bins and len(par_bins) == 1
+
+    def test_strict_raises_with_coordinates(self, bam_file):
+        from disq_tpu import CorruptBlockError
+        from disq_tpu.fsw import FaultSpec
+
+        raw = open(bam_file, "rb").read()
+        corrupt_at = self._block_offset(raw, 11)
+        faults = [FaultSpec(kind="bitflip", offset=corrupt_at + 20, bit=2)]
+        with pytest.raises(CorruptBlockError) as ei:
+            self._fault_read(bam_file, raw, "strict", 8, faults, seed=1)
+        assert ei.value.block_offset == corrupt_at
+
+    def test_transient_only_recovers_byte_identical(self, bam_file):
+        from disq_tpu.fsw import FaultSpec
+
+        raw = open(bam_file, "rb").read()
+        base = ReadsStorage.make_default().split_size(4096).read(bam_file)
+        faults = [FaultSpec(kind="transient", probability=0.05),
+                  FaultSpec(kind="truncate", probability=0.03,
+                            truncate_bytes=77)]
+        ds = self._fault_read(bam_file, raw, "strict", 8, faults, seed=13)
+        assert ds.count() == base.count()
+        np.testing.assert_array_equal(ds.reads.pos, base.reads.pos)
+        np.testing.assert_array_equal(ds.reads.names, base.reads.names)
+        assert ds.counters.retried_reads > 0

@@ -274,15 +274,19 @@ class TestAtomicCreate:
 
 
 @pytest.mark.slow
-def test_chaos_soak_smoke():
+@pytest.mark.parametrize("executor_workers", [1, 4])
+def test_chaos_soak_smoke(executor_workers):
     """One-command randomized soak (scripts/chaos_soak.py) — small N
-    here; the script scales N up for real soak runs."""
+    here; the script scales N up for real soak runs. The second
+    parameterization soaks the parallel shard executor: fault firing
+    order becomes thread-dependent, but the recovery contract (byte
+    identity / bounded loss / strict raise) must hold regardless."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
     proc = subprocess.run(
         [sys.executable, script, "--iterations", "3", "--records", "200",
-         "--seed", "7"],
+         "--seed", "7", "--executor-workers", str(executor_workers)],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )

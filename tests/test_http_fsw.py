@@ -210,6 +210,45 @@ class TestTransientRetry:
         finally:
             srv.shutdown()
 
+    def test_range_ignoring_server_capped_read(self):
+        """A 200-only server must NOT force buffering the whole object:
+        the stream read stops a bounded slack past the requested range
+        (ADVICE r5 #3) while still serving correct bytes and seeding
+        only complete cache blocks."""
+        payload = os.urandom(600_000)
+
+        class NoRange(_RangeHandler):
+            files = {"/big.bin": payload}
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    self.wfile.write(payload)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client abandoned the capped stream
+
+        srv = self._serve(NoRange)
+        try:
+            fs = HttpFileSystemWrapper(block_size=4096, prefetch=False)
+            fs._FULL_READ_SLACK_BLOCKS = 4
+            url = f"http://127.0.0.1:{srv.server_address[1]}/big.bin"
+            got = fs.read_range(url, 1000, 5000)
+            assert got == payload[1000:6000]
+            # bounded: requested prefix + 4 slack blocks, NOT 600 KB
+            cap = 2 * 4096 + 4 * 4096
+            assert fs.stats.bytes_fetched <= cap
+            # the capped prefix's complete blocks serve later reads free
+            before = fs.stats.range_requests
+            assert fs.read_range(url, 0, 4096) == payload[:4096]
+            assert fs.stats.range_requests == before
+            # reads past the cap still work (fresh capped streams)
+            assert fs.read_range(url, 500_000, 1000) == \
+                payload[500_000:501_000]
+        finally:
+            srv.shutdown()
+
     def test_range_ignoring_server_downloads_once(self):
         payload = os.urandom(100_000)
 

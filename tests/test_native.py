@@ -128,3 +128,25 @@ class TestSegmentGatherNative:
             segment_gather_native(flat, off, np.array([3]))
         with pytest.raises(IndexError):
             segment_gather_native(flat, off, np.array([-4]))
+
+    def test_malformed_offsets_rejected(self):
+        """ADVICE r5 #1: a non-monotone offsets table used to compute a
+        negative segment length that cast to a huge size_t memcpy; an
+        offsets[-1] past the flat buffer read beyond it. Both must fail
+        validation BEFORE any copy."""
+        segment_gather_native = native.segment_gather_native
+
+        flat = np.arange(9, dtype=np.uint8)
+        with pytest.raises(ValueError, match="monotone"):
+            segment_gather_native(
+                flat, np.array([0, 5, 2, 9], np.int64), np.array([1]))
+        with pytest.raises(ValueError, match="exceeds"):
+            segment_gather_native(
+                flat, np.array([0, 2, 5, 50], np.int64), np.array([2]))
+        with pytest.raises(ValueError, match="non-negative"):
+            segment_gather_native(
+                flat, np.array([-3, 2, 5, 9], np.int64), np.array([0]))
+        # a valid table still round-trips
+        got_f, _ = segment_gather_native(
+            flat, np.array([0, 2, 5, 9], np.int64), np.array([1]))
+        assert got_f.tolist() == [2, 3, 4]
