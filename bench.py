@@ -479,6 +479,12 @@ def main() -> None:
     configs.update(http_read_config(path, max(2, REPS - 2)))
     configs.update(device_inflate_config(path))
 
+    # Telemetry snapshot accumulated across every config above
+    # (runtime/tracing.py): phase totals + p50/p99, labeled counters
+    # (retries, cache hits/misses, quarantine), gauge peaks — so each
+    # BENCH json carries the *why* behind its rows, not just medians.
+    from disq_tpu.runtime.tracing import telemetry_summary
+
     print(
         json.dumps(
             {
@@ -489,6 +495,7 @@ def main() -> None:
                 "spread": _spread(times_fw),
                 "reps": REPS,
                 "configs": configs,
+                "telemetry": telemetry_summary(),
             }
         )
     )

@@ -50,7 +50,13 @@ from disq_tpu.runtime import (  # noqa: F401
     QuarantineManifest,
     ShardCounters,
     StageManifest,
+    metrics_text,
     phase_report,
     reduce_counters,
+    span,
+    start_span_log,
+    stop_span_log,
+    telemetry_snapshot,
+    telemetry_summary,
     trace_phase,
 )

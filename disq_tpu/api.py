@@ -38,6 +38,24 @@ from disq_tpu.runtime.errors import DisqOptions, ErrorPolicy  # noqa: F401
 # surface — ``ReadsStorage.make_default().error_policy("skip")``.)
 
 
+def _telemetry_report(counters) -> dict:
+    """Dataset-level telemetry bundle: the dataset's reduced per-shard
+    counters together with the process registry (labeled counters,
+    gauges, phase-latency histograms), the phase/gauge views, and the
+    span-log location — one dict answering "what did this read cost
+    and where did the wall-clock go"."""
+    from disq_tpu.runtime import tracing
+
+    return {
+        "run_id": tracing.RUN_ID,
+        "counters": counters.as_dict() if counters is not None else {},
+        "metrics": tracing.telemetry_snapshot(),
+        "phases": tracing.phase_report(),
+        "gauges": tracing.gauge_report(),
+        "span_log": tracing.span_log_path(),
+    }
+
+
 class WriteOption:
     """Marker base for varargs write options (ref: ``WriteOption.java``)."""
 
@@ -143,6 +161,12 @@ class ReadsDataset:
     def count(self) -> int:
         return int(self.reads.count)
 
+    def telemetry_report(self) -> dict:
+        """This dataset's reduced shard counters + the process
+        telemetry registry (labeled counters, gauges, phase-latency
+        histograms) in one dict — see ``runtime/tracing.py``."""
+        return _telemetry_report(self.counters)
+
     def coordinate_sorted(self) -> "ReadsDataset":
         from disq_tpu.sort.coordinate import coordinate_sort_batch
 
@@ -198,6 +222,10 @@ class VariantsDataset:
 
     def count(self) -> int:
         return int(self.variants.count)
+
+    def telemetry_report(self) -> dict:
+        """See ``ReadsDataset.telemetry_report``."""
+        return _telemetry_report(self.counters)
 
 
 def _opt(options, cls, default):
@@ -267,6 +295,16 @@ class ReadsStorage:
         default) is the sequential-compatible inline path. Output is
         byte-identical for any ``n``."""
         self._options = self._options.with_executor(n, prefetch_shards)
+        return self
+
+    def span_log(self, path: str) -> "ReadsStorage":
+        """Point the process-wide JSONL span sink at ``path`` when a
+        read through this storage starts (the input of
+        ``scripts/trace_report.py``).  One sink per process — see
+        ``DisqOptions.span_log`` for the exact semantics."""
+        from dataclasses import replace
+
+        self._options = replace(self._options, span_log=path)
         return self
 
     def num_shards(self, n: int) -> "ReadsStorage":
@@ -344,6 +382,13 @@ class VariantsStorage:
         BGZF-split VCF, BCF block inflate) — see
         ``ReadsStorage.executor_workers``."""
         self._options = self._options.with_executor(n, prefetch_shards)
+        return self
+
+    def span_log(self, path: str) -> "VariantsStorage":
+        """See ``ReadsStorage.span_log``."""
+        from dataclasses import replace
+
+        self._options = replace(self._options, span_log=path)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

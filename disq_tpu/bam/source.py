@@ -417,6 +417,25 @@ class BamSource:
         hi_voffset: int,
         ctx,
     ) -> Optional[Tuple]:
+        """``_fetch_range_inner`` under a per-split ``bam.split.fetch``
+        span carrying the shard id and virtual-offset range — one
+        timeline event per split fetch, replayable by
+        ``scripts/trace_report.py``."""
+        from disq_tpu.runtime.tracing import span
+
+        with span("bam.split.fetch", shard=ctx.shard_id,
+                  lo=lo_voffset, hi=hi_voffset, path=path):
+            return self._fetch_range_inner(
+                fs, path, lo_voffset, hi_voffset, ctx)
+
+    def _fetch_range_inner(
+        self,
+        fs: FileSystemWrapper,
+        path: str,
+        lo_voffset: int,
+        hi_voffset: int,
+        ctx,
+    ) -> Optional[Tuple]:
         """Stage A: range-read and walk the compressed blocks covering
         [lo, hi) virtual space — from lo's block through hi's block,
         i.e. past the split's byte-range end when a record straddles it.
@@ -458,6 +477,19 @@ class BamSource:
         return blocks, data, gaps, lo_voffset, hi_voffset
 
     def _decode_fetched(
+        self,
+        header: SamHeader,
+        fetched: Optional[Tuple],
+        ctx,
+    ) -> Tuple[ReadBatch, Tuple[int, int, int]]:
+        """``_decode_fetched_inner`` under a per-split
+        ``bam.split.decode`` span carrying the shard id."""
+        from disq_tpu.runtime.tracing import span
+
+        with span("bam.split.decode", shard=ctx.shard_id):
+            return self._decode_fetched_inner(header, fetched, ctx)
+
+    def _decode_fetched_inner(
         self,
         header: SamHeader,
         fetched: Optional[Tuple],

@@ -92,6 +92,16 @@ def inflate_blocks(
     if not blocks:
         return np.empty(0, dtype=np.uint8) if as_array else b""
     from disq_tpu.runtime.debug import env_flag
+    from disq_tpu.runtime.tracing import span
+
+    with span("codec.inflate.batch", blocks=len(blocks)):
+        return _inflate_blocks_timed(
+            data, blocks, base, verify_crc, as_array, env_flag)
+
+
+def _inflate_blocks_timed(data, blocks, base, verify_crc, as_array,
+                          env_flag):
+    import numpy as np
 
     if env_flag("DISQ_TPU_DEVICE_INFLATE"):
         out = inflate_blocks_device(data, blocks, base, verify_crc=verify_crc)

@@ -105,6 +105,7 @@ class CramSource:
 
         from disq_tpu.runtime import ShardTask
         from disq_tpu.runtime.executor import executor_for_storage
+        from disq_tpu.runtime.tracing import wrap_span
 
         tasks, shard_ctxs, owned_by_shard = [], [], []
         for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
@@ -117,11 +118,21 @@ class CramSource:
             owned_by_shard.append(owned)
             tasks.append(ShardTask(
                 shard_id=i,
-                fetch=functools.partial(
-                    self._fetch_split_containers, fs, path, owned, shard_ctx),
-                decode=functools.partial(
-                    self._decode_split_containers, ref_fetch=ref_fetch,
-                    shard_ctx=shard_ctx),
+                # Per-split timeline spans carrying shard id, byte range
+                # and owned-container count.
+                fetch=wrap_span(
+                    "cram.split.fetch",
+                    functools.partial(
+                        self._fetch_split_containers, fs, path, owned,
+                        shard_ctx),
+                    shard=i, start=s.start, end=s.end,
+                    containers=len(owned)),
+                decode=wrap_span(
+                    "cram.split.decode",
+                    functools.partial(
+                        self._decode_split_containers, ref_fetch=ref_fetch,
+                        shard_ctx=shard_ctx),
+                    shard=i, containers=len(owned)),
                 retrier=shard_ctx.retrier,
                 what=f"cram-shard{i}",
             ))

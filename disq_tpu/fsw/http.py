@@ -16,8 +16,11 @@ Reads are served from a block cache of fixed-size ranges with an
 **async prefetch** of the next block on every cache miss, so a
 sequential scan (the BamSource staging pattern) always has the next
 range in flight while the current one decodes. The wrapper's ``stats``
-(range_requests / bytes_fetched / prefetch_issued / prefetch_hits)
-makes the staging behavior observable and testable.
+(range_requests / bytes_fetched / prefetch_issued / prefetch_hits /
+cache_hits / cache_misses / cache_evictions) makes the staging
+behavior observable and testable; the same events feed the telemetry
+registry (``fsw.http.cache.*`` counters and the
+``fsw.http.range_get`` latency histogram).
 
 Writes are not supported (the reference writes through Hadoop's
 committer; our sinks stage locally and upload out-of-band).
@@ -34,6 +37,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import BinaryIO, List, Tuple
 
 from disq_tpu.fsw.filesystem import FileSystemWrapper
+from disq_tpu.runtime.tracing import counter as _counter
+from disq_tpu.runtime.tracing import span as _span
 
 DEFAULT_BLOCK = 4 * 1024 * 1024
 
@@ -50,7 +55,8 @@ def rewrite_remote_uri(path: str) -> str:
 
 class _Stats:
     __slots__ = ("range_requests", "bytes_fetched", "prefetch_hits",
-                 "prefetch_issued", "retries")
+                 "prefetch_issued", "retries", "cache_hits",
+                 "cache_misses", "cache_evictions")
 
     def __init__(self) -> None:
         self.range_requests = 0
@@ -58,6 +64,13 @@ class _Stats:
         self.prefetch_hits = 0
         self.prefetch_issued = 0
         self.retries = 0
+        # Block-LRU efficacy (mirrored as fsw.http.cache.* telemetry
+        # counters): a hit is a ``_block`` call served from cached
+        # bytes or a completed prefetch; a miss pays an inline fetch;
+        # an eviction drops one completed block from the LRU head.
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
 
 
 class HttpFileSystemWrapper(FileSystemWrapper):
@@ -100,6 +113,8 @@ class HttpFileSystemWrapper(FileSystemWrapper):
             if isinstance(old, Future) and not old.done():
                 continue  # never drop an in-flight prefetch
             self._cache.pop(old_key)
+            self.stats.cache_evictions += 1
+            _counter("fsw.http.cache.evictions").inc()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -161,7 +176,8 @@ class HttpFileSystemWrapper(FileSystemWrapper):
                 full = b"".join(chunks)
                 return full[start: end_incl + 1], full
 
-        data, full = self._retrying(ranged_get)
+        with _span("fsw.http.range_get", start=start, end=end_incl):
+            data, full = self._retrying(ranged_get)
         if full is not None:
             bs = self.block_size
             want = start // bs
@@ -196,6 +212,9 @@ class HttpFileSystemWrapper(FileSystemWrapper):
             if entry is not None:
                 self._cache.move_to_end(key)
         if isinstance(entry, bytes):
+            with self._lock:
+                self.stats.cache_hits += 1
+            _counter("fsw.http.cache.hits").inc()
             return entry
         if isinstance(entry, Future):
             try:
@@ -212,7 +231,12 @@ class HttpFileSystemWrapper(FileSystemWrapper):
                 with self._lock:
                     self._cache_put(key, data)
                     self.stats.prefetch_hits += 1
+                    self.stats.cache_hits += 1
+                _counter("fsw.http.cache.hits").inc()
                 return data
+        with self._lock:
+            self.stats.cache_misses += 1
+        _counter("fsw.http.cache.misses").inc()
         start = idx * self.block_size
         end = min(start + self.block_size, length) - 1
         data = self._fetch(url, start, end)

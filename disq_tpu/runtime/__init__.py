@@ -19,9 +19,12 @@ protocol. JAX has no task retry, so the equivalents here are:
   splits (``DisqOptions.executor_workers`` / ``prefetch_shards``).
 - ``counters`` — per-shard counters (records, blocks, bytes,
   compression ratio) returned per shard and reduced.
-- ``tracing`` — phase wrappers around ``jax.profiler`` traces plus
-  wall-clock structured logs (``DISQ_TPU_TRACE_DIR`` emits perfetto
-  traces).
+- ``tracing`` — the structured telemetry layer: a labeled
+  ``MetricsRegistry`` (counters / gauges / histograms, Prometheus
+  ``metrics_text()``), per-shard ``span`` timelines with a bounded
+  ring + JSONL sink (``DISQ_TPU_TRACE_JSONL``, Chrome/Perfetto
+  export), and the ``jax.profiler`` bridge (``trace_phase``,
+  ``DISQ_TPU_TRACE_DIR``).
 - ``debug`` — a debug mode (``DISQ_TPU_DEBUG=1``) asserting
   shard-boundary invariants (record counts, offset monotonicity)
   after each phase.
@@ -55,10 +58,27 @@ from disq_tpu.runtime.manifest import (  # noqa: F401
     StageManifest,
 )
 from disq_tpu.runtime.tracing import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+    chrome_trace_events,
+    counter,
+    export_chrome_trace,
+    gauge,
     gauge_report,
+    histogram,
+    metrics_text,
     observe_gauge,
     phase_report,
+    record_span,
+    reset_telemetry,
+    span,
+    spans,
+    start_span_log,
+    stop_span_log,
+    telemetry_snapshot,
+    telemetry_summary,
     trace_phase,
+    wrap_span,
 )
 from disq_tpu.runtime.debug import (  # noqa: F401
     debug_enabled,

@@ -8,8 +8,9 @@ explicit, observable machinery:
 - **Transient faults** (network blips, stalled connections, truncated
   range reads) are retried per shard with bounded exponential backoff
   (``ShardRetrier`` — the Spark-task-retry analogue). Every retry is
-  counted (``ShardCounters.retried_reads``) and traced
-  (``trace_phase("retry.<what>")``).
+  counted (``ShardCounters.retried_reads`` plus the labeled
+  ``retry.attempts`` telemetry counter) and its backoff sleep traced
+  as a ``retry.backoff`` span labeled with what was being retried.
 - **Corrupt data** (failed CRC, bad DEFLATE bits, impossible record
   framing) is *not* retried — re-reading corrupt bytes yields the same
   corrupt bytes. It is governed by an ``ErrorPolicy``:
@@ -74,6 +75,16 @@ class DisqOptions:
     inflate and record decode across splits with at most
     ``prefetch_shards`` splits in flight past the emit frontier
     (None ⇒ ``2 × executor_workers``).
+
+    ``span_log`` points the *process-wide* JSONL span sink at the
+    given path when a read through this storage starts (per-shard
+    fetch/decode, retries, quarantine writes — the file
+    ``scripts/trace_report.py`` replays).  Equivalent to setting
+    ``DISQ_TPU_TRACE_JSONL`` at read time: there is one sink per
+    process, so the storage that most recently started a read wins,
+    and the sink keeps collecting until ``stop_span_log()`` (each
+    run's spans carry its ``run_id``, so appended runs stay
+    separable).
     """
 
     error_policy: ErrorPolicy = ErrorPolicy.STRICT
@@ -82,6 +93,7 @@ class DisqOptions:
     quarantine_dir: Optional[str] = None
     executor_workers: int = 1
     prefetch_shards: Optional[int] = None
+    span_log: Optional[str] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -206,7 +218,7 @@ class ShardRetrier:
 
     def call(self, fn: Callable[..., T], *args: Any,
              what: str = "read", **kwargs: Any) -> T:
-        from disq_tpu.runtime.tracing import trace_phase
+        from disq_tpu.runtime.tracing import counter, span
 
         attempt = 0
         while True:
@@ -217,7 +229,8 @@ class ShardRetrier:
                     raise
                 attempt += 1
                 self.retried += 1
-                with trace_phase(f"retry.{what}"):
+                counter("retry.attempts").inc(what=what)
+                with span("retry.backoff", what=what, attempt=attempt):
                     self._sleep(self.backoff_s * (2 ** (attempt - 1)))
 
 
@@ -265,7 +278,12 @@ class ShardErrorContext:
     ) -> None:
         """Apply the policy to one corrupt block. STRICT raises a
         ``CorruptBlockError`` with full coordinates; SKIP counts;
-        QUARANTINE additionally copies ``raw`` to the sidecar."""
+        QUARANTINE additionally copies ``raw`` to the sidecar.  Counted
+        outcomes are also booked as labeled telemetry counters
+        (``errors.skipped_blocks`` / ``quarantine.blocks``) unless this
+        is a ``silent()`` non-owner view."""
+        from disq_tpu.runtime.tracing import counter
+
         if self.policy is ErrorPolicy.STRICT:
             raise CorruptBlockError(
                 f"corrupt {kind}: {error}",
@@ -274,6 +292,7 @@ class ShardErrorContext:
                 block_offset=block_offset,
                 virtual_offset=virtual_offset,
             ) from error
+        silent = getattr(self, "_is_silent", False)
         if self.policy is ErrorPolicy.QUARANTINE:
             self._quarantine_sink().quarantine(
                 self.path,
@@ -285,8 +304,12 @@ class ShardErrorContext:
                 kind=kind,
             )
             self.quarantined_blocks += 1
+            if not silent:
+                counter("quarantine.blocks").inc(kind=kind)
         else:
             self.skipped_blocks += 1
+            if not silent:
+                counter("errors.skipped_blocks").inc(kind=kind)
 
     def silent(self) -> "ShardErrorContext":
         """A non-counting view for blocks this shard reads but does NOT
@@ -297,9 +320,14 @@ class ShardErrorContext:
         at first sight is identical to failing when the owner decodes."""
         if self.policy is ErrorPolicy.STRICT:
             return self
-        return ShardErrorContext(
+        ctx = ShardErrorContext(
             policy=ErrorPolicy.SKIP, path=self.path, shard_id=self.shard_id
         )
+        # Non-owner views never book telemetry counters either — the
+        # owning shard's context does (same one-owner rule as the
+        # ShardCounters bookkeeping).
+        ctx._is_silent = True  # type: ignore[attr-defined]
+        return ctx
 
     # Sink creation races under the parallel shard executor: two shards
     # hitting their first corrupt block concurrently must share ONE
@@ -334,8 +362,14 @@ class ShardErrorContext:
 
 def context_for_storage(storage, path: str) -> ShardErrorContext:
     """Build the read-path error context from a storage builder's
-    ``DisqOptions`` (absent/None ⇒ defaults: STRICT, 3 retries)."""
+    ``DisqOptions`` (absent/None ⇒ defaults: STRICT, 3 retries).
+    Every source funnels through here, so this is also where the
+    ``span_log`` knob turns on the JSONL span sink for the read."""
     opts = getattr(storage, "_options", None) or DisqOptions()
+    if getattr(opts, "span_log", None):
+        from disq_tpu.runtime.tracing import start_span_log
+
+        start_span_log(opts.span_log)
     return ShardErrorContext(
         policy=ErrorPolicy.coerce(opts.error_policy),
         path=path,

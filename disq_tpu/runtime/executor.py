@@ -37,10 +37,11 @@ Guarantees:
   fetch) re-run the shard from fetch under the same retrier. Corrupt
   data follows the shard's ``ErrorPolicy`` exactly as in the sequential
   path; the first raising shard aborts the pipeline.
-- **Observability.** Per-stage ``trace_phase`` spans
-  (``executor.fetch`` / ``executor.decode`` / ``executor.emit.stall``)
-  plus ``ExecutorStats`` (stage seconds, emit-stall seconds, max queue
-  depth) and ``tracing.observe_gauge("executor.in_flight", …)`` make
+- **Observability.** Per-stage, per-shard telemetry spans
+  (``executor.fetch`` / ``executor.decode`` / ``executor.emit.stall``,
+  each labeled with the shard id and feeding the same-named latency
+  histogram) plus ``ExecutorStats`` (stage seconds, emit-stall
+  seconds, max queue depth) and the ``executor.in_flight`` gauge make
   the overlap measurable, not asserted.
 """
 
@@ -53,7 +54,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 from disq_tpu.runtime.errors import DisqOptions, ShardRetrier, is_transient
-from disq_tpu.runtime.tracing import observe_gauge, record_phase, trace_phase
+from disq_tpu.runtime.tracing import observe_gauge, record_span, span
 
 
 @dataclass
@@ -161,11 +162,11 @@ class ShardPipelineExecutor:
 
         def attempt():
             t0 = time.perf_counter()
-            with trace_phase("executor.fetch"):
+            with span("executor.fetch", shard=task.shard_id):
                 payload = task.fetch()
             t1 = time.perf_counter()
             times[0] += t1 - t0
-            with trace_phase("executor.decode"):
+            with span("executor.decode", shard=task.shard_id):
                 value = task.decode(payload)
             times[1] += time.perf_counter() - t1
             return value
@@ -204,7 +205,7 @@ class ShardPipelineExecutor:
         def decode_job(task: ShardTask, payload: Any, tf: float) -> None:
             t0 = time.perf_counter()
             try:
-                with trace_phase("executor.decode"):
+                with span("executor.decode", shard=task.shard_id):
                     value = self._decode_with_refetch(task, payload)
             except BaseException as e:  # noqa: BLE001 — re-raised at emit
                 record_error(task.shard_id, e)
@@ -226,7 +227,7 @@ class ShardPipelineExecutor:
                     return
             t0 = time.perf_counter()
             try:
-                with trace_phase("executor.fetch"):
+                with span("executor.fetch", shard=task.shard_id):
                     if task.retrier is not None:
                         payload = task.retrier.call(
                             task.fetch, what=f"{task.what}.fetch")
@@ -265,7 +266,8 @@ class ShardPipelineExecutor:
                         self.stats.emit_stall_seconds += stall
                         if stall > 0.0005:
                             # only meaningful waits become trace spans
-                            record_phase("executor.emit.stall", stall)
+                            record_span("executor.emit.stall", stall,
+                                        shard=i)
                         if i in errors:
                             state["aborted"] = True
                             raise errors[i]

@@ -221,33 +221,39 @@ class QuarantineManifest:
         error: str = "",
         kind: str = "block",
     ) -> str:
-        """Copy one corrupt block aside; returns the sidecar path."""
-        os.makedirs(self.base_dir, exist_ok=True)
-        tag = hashlib.sha1(path.encode()).hexdigest()[:8]
-        sidecar = os.path.join(
-            self.base_dir, f"block-{tag}-{block_offset}.bin")
-        # Atomic sidecar commit: a crash between sidecar write and
-        # ledger append must not leave a truncated sidecar that a
-        # recorded entry later points at.
-        fd, tmp = tempfile.mkstemp(dir=self.base_dir, prefix=".block-")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(raw)
-            os.replace(tmp, sidecar)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
-        entry = {
-            "path": path,
-            "shard_id": shard_id,
-            "block_offset": block_offset,
-            "virtual_offset": virtual_offset,
-            "kind": kind,
-            "error": error,
-            "sidecar": sidecar,
-            "length": len(raw),
-        }
-        self._entries[(path, block_offset)] = entry
-        self._append(entry)
-        return sidecar
+        """Copy one corrupt block aside; returns the sidecar path.
+        Timed as a ``quarantine.write`` span so a slow quarantine disk
+        shows up on the shard timeline, not just as mystery stall."""
+        from disq_tpu.runtime.tracing import span
+
+        with span("quarantine.write", shard=shard_id,
+                  block_offset=block_offset, kind=kind):
+            os.makedirs(self.base_dir, exist_ok=True)
+            tag = hashlib.sha1(path.encode()).hexdigest()[:8]
+            sidecar = os.path.join(
+                self.base_dir, f"block-{tag}-{block_offset}.bin")
+            # Atomic sidecar commit: a crash between sidecar write and
+            # ledger append must not leave a truncated sidecar that a
+            # recorded entry later points at.
+            fd, tmp = tempfile.mkstemp(dir=self.base_dir, prefix=".block-")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(raw)
+                os.replace(tmp, sidecar)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+            entry = {
+                "path": path,
+                "shard_id": shard_id,
+                "block_offset": block_offset,
+                "virtual_offset": virtual_offset,
+                "kind": kind,
+                "error": error,
+                "sidecar": sidecar,
+                "length": len(raw),
+            }
+            self._entries[(path, block_offset)] = entry
+            self._append(entry)
+            return sidecar

@@ -1,34 +1,703 @@
-"""Phase tracing — ``jax.profiler`` wrappers + structured wall-clock log.
+"""Structured telemetry — labeled metrics registry + per-shard span timelines.
 
-The reference's observability is the Spark UI plus slf4j loggers
-(SURVEY.md §5); here each pipeline phase is wrapped in
-``trace_phase(name)``:
+The reference's only observability is the Spark UI plus slf4j loggers
+(SURVEY.md §5).  disq_tpu replaces both with a process-local telemetry
+layer shared by every subsystem:
 
-- always: wall-clock timing, accumulated in a process-local registry
-  readable via ``phase_report()`` and logged at DEBUG level;
-- under a profiler capture: a ``jax.profiler.TraceAnnotation`` so the
-  phase shows up on the XLA timeline;
-- with ``DISQ_TPU_TRACE_DIR`` set (or ``start_trace(dir)`` called), a
-  perfetto/tensorboard trace of everything between the first phase
-  entered and process exit (or ``stop_trace()``) is written there.
+- **Metrics registry** (``MetricsRegistry`` / module-level ``REGISTRY``):
+  labeled ``Counter`` / ``Gauge`` (min/max/last/mean) / fixed-bucket
+  ``Histogram`` handles, thread-safe and resettable.  Exported as
+  Prometheus text exposition via ``metrics_text()`` and as a plain dict
+  via ``telemetry_snapshot()`` / ``telemetry_summary()``.
+- **Span timeline**: ``span(name, shard=…)`` context managers emit
+  ``{ts, dur, name, labels}`` events with a process-wide ``run_id`` and
+  monotonic timestamps into a bounded in-memory ring (default 64k
+  events; overflow drops the oldest and counts
+  ``telemetry.dropped_spans``) plus an optional JSONL sink
+  (``DISQ_TPU_TRACE_JSONL`` or ``start_span_log(path)`` /
+  ``DisqOptions.span_log``).  A whole BAM read becomes a replayable
+  per-shard timeline (``scripts/trace_report.py``) instead of a sum.
+- **Exporters**: Chrome/Perfetto ``trace_event`` JSON
+  (``chrome_trace_events`` / ``export_chrome_trace``) and Prometheus
+  text (``metrics_text``).
+- **jax.profiler bridge**: ``trace_phase(name)`` additionally opens a
+  ``jax.profiler.TraceAnnotation`` so phases appear on the XLA
+  timeline, and ``DISQ_TPU_TRACE_DIR`` (or ``start_trace(dir)``)
+  captures a perfetto/tensorboard trace of everything between the
+  first phase entered and process exit (or ``stop_trace()``).
+
+Metric taxonomy (dotted names, linted by ``scripts/check_metrics.py``
+against the README table):
+
+- ``executor.*``  — shard-pipeline executor: per-shard ``executor.fetch``
+  / ``executor.decode`` spans + latency histograms, the
+  ``executor.emit.stall`` ordered-emit stall histogram, and the
+  ``executor.in_flight`` window-depth gauge.
+- ``retry.*``     — transient-fault machinery: ``retry.attempts``
+  (counter, labeled ``what=``) and ``retry.backoff`` (sleep spans).
+- ``errors.*`` / ``quarantine.*`` — corrupt-block policy outcomes:
+  ``errors.skipped_blocks``, ``quarantine.blocks`` (counters, labeled
+  ``kind=``) and ``quarantine.write`` sidecar-write spans.
+- ``fsw.http.*``  — remote I/O: ``fsw.http.range_get`` latency
+  spans/histogram and the block-LRU efficacy counters
+  ``fsw.http.cache.hits`` / ``fsw.http.cache.misses`` /
+  ``fsw.http.cache.evictions``.
+- ``codec.*``     — codec batch work: ``codec.inflate.batch`` spans.
+- ``bam.*`` / ``vcf.*`` / ``bcf.*`` / ``cram.*`` — format phases
+  (``bam.read.header`` …) and per-split ``<fmt>.split.fetch`` /
+  ``<fmt>.split.decode`` spans carrying shard id + byte range.
+- ``telemetry.*`` — self-observation (``telemetry.dropped_spans``).
+
+Back-compat: ``trace_phase`` / ``record_phase`` / ``phase_report`` /
+``observe_gauge`` / ``gauge_report`` are thin views over the registry —
+phases are unlabeled duration histograms, so ``phase_report()`` keeps
+returning ``{name: {calls, total_s}}``.
 """
 
 from __future__ import annotations
 
 import atexit
 import contextlib
+import json
 import logging
 import os
 import threading
 import time
-from typing import Dict, Iterator, List, Tuple
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 logger = logging.getLogger("disq_tpu.tracing")
 
+# Process-wide run id: every span carries it, so timelines from
+# different runs/processes appended to one JSONL stay separable.
+RUN_ID = f"{os.getpid():x}-{time.time_ns() & 0xFFFFFFFF:08x}"
+
+# Default latency buckets (seconds): spans are I/O + decode phases that
+# range from sub-millisecond (cache hit) to tens of seconds (cold
+# remote shard).  Fixed buckets keep observe() O(len(buckets)) with no
+# allocation.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_LabelKey = Tuple[Tuple[str, Any], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+def _label_str(key: _LabelKey) -> str:
+    return ",".join(f"{k}={v}" for k, v in key)
+
+
+class Counter:
+    """Monotonic labeled counter handle: ``inc(n, **labels)``."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, registry: "MetricsRegistry") -> None:
+        self.name = name
+        self._registry = registry
+        self._values: Dict[_LabelKey, float] = {}
+
+    def inc(self, n: float = 1, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._registry._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def value(self, **labels: Any) -> float:
+        """Value for one exact labelset (no labels ⇒ the unlabeled
+        series)."""
+        with self._registry._lock:
+            return self._values.get(_label_key(labels), 0)
+
+    def total(self) -> float:
+        """Sum across every labelset."""
+        with self._registry._lock:
+            return sum(self._values.values())
+
+    def _reset(self) -> None:
+        self._values.clear()
+
+    def _snapshot(self) -> Dict[str, float]:
+        return {_label_str(k): v for k, v in sorted(self._values.items())}
+
+
+class Gauge:
+    """Level-style labeled quantity (queue depth, in-flight shards):
+    keeps min / max / last / mean per labelset — gauges are states, not
+    durations."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, registry: "MetricsRegistry") -> None:
+        self.name = name
+        self._registry = registry
+        self._states: Dict[_LabelKey, Dict[str, float]] = {}
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._registry._lock:
+            g = self._states.get(key)
+            if g is None:
+                self._states[key] = {
+                    "min": value, "max": value, "last": value,
+                    "sum": value, "samples": 1,
+                }
+            else:
+                g["min"] = min(g["min"], value)
+                g["max"] = max(g["max"], value)
+                g["last"] = value
+                g["sum"] += value
+                g["samples"] += 1
+
+    def state(self, **labels: Any) -> Optional[Dict[str, float]]:
+        with self._registry._lock:
+            g = self._states.get(_label_key(labels))
+            return None if g is None else self._view(g)
+
+    @staticmethod
+    def _view(g: Dict[str, float]) -> Dict[str, float]:
+        out = {k: g[k] for k in ("min", "max", "last", "samples")}
+        out["mean"] = g["sum"] / g["samples"] if g["samples"] else 0.0
+        return out
+
+    def _reset(self) -> None:
+        self._states.clear()
+
+    def _snapshot(self) -> Dict[str, Dict[str, float]]:
+        return {
+            _label_str(k): self._view(g)
+            for k, g in sorted(self._states.items())
+        }
+
+
+class Histogram:
+    """Fixed-bucket labeled histogram with percentile estimation.
+
+    ``observe(seconds)`` is O(len(buckets)); ``percentile(p)`` linearly
+    interpolates inside the winning bucket, clamped to the observed
+    min/max so a single sample reports itself exactly."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, registry: "MetricsRegistry",
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                 unit: str = "seconds") -> None:
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self.unit = unit
+        self._registry = registry
+        # labelset -> [bucket counts... , +Inf count]
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._stats: Dict[_LabelKey, Dict[str, float]] = {}
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._registry._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._stats[key] = {"count": 0, "sum": 0.0,
+                                    "min": value, "max": value}
+            i = 0
+            for i, b in enumerate(self.buckets):  # noqa: B007
+                if value <= b:
+                    break
+            else:
+                i = len(self.buckets)
+            counts[i] += 1
+            st = self._stats[key]
+            st["count"] += 1
+            st["sum"] += value
+            st["min"] = min(st["min"], value)
+            st["max"] = max(st["max"], value)
+
+    # -- read side ---------------------------------------------------------
+
+    def _merged(self) -> Tuple[List[int], Dict[str, float]]:
+        """Aggregate counts+stats across every labelset (caller holds
+        the registry lock)."""
+        counts = [0] * (len(self.buckets) + 1)
+        stats = {"count": 0, "sum": 0.0, "min": float("inf"), "max": 0.0}
+        for key, c in self._counts.items():
+            for i, n in enumerate(c):
+                counts[i] += n
+            st = self._stats[key]
+            stats["count"] += st["count"]
+            stats["sum"] += st["sum"]
+            stats["min"] = min(stats["min"], st["min"])
+            stats["max"] = max(stats["max"], st["max"])
+        if stats["count"] == 0:
+            stats["min"] = 0.0
+        return counts, stats
+
+    @property
+    def count(self) -> int:
+        with self._registry._lock:
+            return self._merged()[1]["count"]
+
+    @property
+    def sum(self) -> float:
+        with self._registry._lock:
+            return self._merged()[1]["sum"]
+
+    def percentile(self, p: float) -> float:
+        """Estimate the p-th percentile (p in [0, 100]) across all
+        labelsets from the bucket counts."""
+        with self._registry._lock:
+            counts, stats = self._merged()
+        total = stats["count"]
+        if total == 0:
+            return 0.0
+        rank = p / 100.0 * total
+        cum = 0
+        lo = stats["min"]
+        for i, n in enumerate(counts):
+            if n == 0:
+                continue
+            hi = (self.buckets[i] if i < len(self.buckets)
+                  else stats["max"])
+            if cum + n >= rank:
+                frac = (rank - cum) / n
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return max(stats["min"], min(stats["max"], est))
+            cum += n
+            lo = hi
+        return stats["max"]
+
+    def _reset(self) -> None:
+        self._counts.clear()
+        self._stats.clear()
+
+    def _snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key in sorted(self._counts):
+            counts = self._counts[key]
+            st = self._stats[key]
+            out[_label_str(key)] = {
+                "count": st["count"],
+                "sum": round(st["sum"], 6),
+                "min": round(st["min"], 6),
+                "max": round(st["max"], 6),
+                "buckets": {
+                    ("+Inf" if i == len(self.buckets)
+                     else repr(self.buckets[i])): n
+                    for i, n in enumerate(counts) if n
+                },
+            }
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric registry.  ``counter`` / ``gauge`` /
+    ``histogram`` create-or-return handles; registering one name as two
+    different kinds raises (the metric-name lint makes that a CI
+    failure before it is a runtime one)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, Any] = {}
+
+    def _get(self, name: str, factory: Callable[[], Any], kind: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif m.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {kind}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, lambda: Counter(name, self), "counter")
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, lambda: Gauge(name, self), "gauge")
+
+    def histogram(self, name: str,
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                  unit: str = "seconds") -> Histogram:
+        return self._get(
+            name, lambda: Histogram(name, self, buckets, unit), "histogram")
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def reset(self) -> None:
+        """Zero every metric (handles stay registered, so references
+        held by long-lived objects keep working)."""
+        with self._lock:
+            for m in self._metrics.values():
+                m._reset()
+
+    # -- exporters ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Full registry state as a JSON-serializable dict:
+        ``{"counters": …, "gauges": …, "histograms": …}``, each keyed
+        by metric name then labelset string (``""`` = unlabeled)."""
+        out: Dict[str, Dict[str, Any]] = {
+            "counters": {}, "gauges": {}, "histograms": {},
+        }
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                snap = m._snapshot()
+                if snap:
+                    out[m.kind + "s"][name] = snap
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact one-level summary (what ``bench.py`` embeds):
+        counters as cross-label totals, gauges as last/max, histograms
+        as calls/total/p50/p99.  The lock (re-entrant) is held across
+        the whole walk so concurrent first-observations of a labelset
+        can't mutate a state dict mid-iteration."""
+        out: Dict[str, Any] = {"counters": {}, "gauges": {}, "phases": {}}
+        with self._lock:
+            items = sorted(self._metrics.items())
+            for name, m in items:
+                self._summarize_one(name, m, out)
+        return out
+
+    def _summarize_one(self, name: str, m, out: Dict[str, Any]) -> None:
+        # caller holds self._lock
+        if m.kind == "counter":
+            total = m.total()
+            if total:
+                out["counters"][name] = total
+        elif m.kind == "gauge":
+            snap = m._snapshot()
+            if snap:
+                merged = list(snap.values())
+                out["gauges"][name] = {
+                    "last": merged[-1]["last"],
+                    "max": max(g["max"] for g in merged),
+                }
+        else:
+            if m.count:
+                out["phases"][name] = {
+                    "calls": m.count,
+                    "total_s": round(m.sum, 6),
+                    "p50_s": round(m.percentile(50), 6),
+                    "p99_s": round(m.percentile(99), 6),
+                }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition.  Dotted names become
+        ``disq_tpu_``-prefixed underscore names; histograms get the
+        conventional ``_bucket``/``_sum``/``_count`` series with
+        cumulative ``le`` labels."""
+        def prom_name(name: str) -> str:
+            return "disq_tpu_" + name.replace(".", "_")
+
+        def esc(v: Any) -> str:
+            return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+        def fmt_labels(key: _LabelKey, extra: str = "") -> str:
+            parts = ['%s="%s"' % (k, esc(v)) for k, v in key]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        def fmt_val(v: float) -> str:
+            return repr(round(v, 9)) if isinstance(v, float) else str(v)
+
+        lines: List[str] = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+            for name, m in items:
+                pn = prom_name(name)
+                if m.kind == "counter":
+                    if not m._values:
+                        continue
+                    lines.append(f"# TYPE {pn} counter")
+                    for key, v in sorted(m._values.items()):
+                        lines.append(f"{pn}{fmt_labels(key)} {fmt_val(v)}")
+                elif m.kind == "gauge":
+                    if not m._states:
+                        continue
+                    lines.append(f"# TYPE {pn} gauge")
+                    for key, g in sorted(m._states.items()):
+                        lines.append(
+                            f"{pn}{fmt_labels(key)} {fmt_val(g['last'])}")
+                else:
+                    if not m._counts:
+                        continue
+                    hn = pn + ("_" + m.unit if m.unit else "")
+                    lines.append(f"# TYPE {hn} histogram")
+                    for key in sorted(m._counts):
+                        counts = m._counts[key]
+                        st = m._stats[key]
+                        cum = 0
+                        for i, n in enumerate(counts):
+                            cum += n
+                            le = ("+Inf" if i == len(m.buckets)
+                                  else repr(m.buckets[i]))
+                            lines.append(
+                                "%s_bucket%s %d" % (
+                                    hn, fmt_labels(key, 'le="%s"' % le), cum))
+                        lines.append(
+                            f"{hn}_sum{fmt_labels(key)} "
+                            f"{fmt_val(st['sum'])}")
+                        lines.append(
+                            f"{hn}_count{fmt_labels(key)} "
+                            f"{int(st['count'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str,
+              buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, buckets)
+
+
+def metrics_text() -> str:
+    return REGISTRY.metrics_text()
+
+
+def telemetry_snapshot() -> Dict[str, Any]:
+    return REGISTRY.snapshot()
+
+
+def telemetry_summary() -> Dict[str, Any]:
+    return REGISTRY.summary()
+
+
+# ---------------------------------------------------------------------------
+# Span timeline: bounded ring + optional JSONL sink
+# ---------------------------------------------------------------------------
+
+DEFAULT_SPAN_RING = 65536
+
+_span_lock = threading.Lock()
+_span_ring: "deque[Dict[str, Any]]" = deque(maxlen=DEFAULT_SPAN_RING)
+_span_sink = None            # open file object, or None
+_span_sink_path: Optional[str] = None
+_span_writes = 0             # lines since the last explicit flush
+_SINK_FLUSH_EVERY = 64       # amortize flushes: a synchronous flush per
+                             # span would serialize every worker thread
+                             # on trace-disk latency (close() flushes
+                             # the tail, so at most this many spans are
+                             # lost to a hard crash)
+_env_resolved = False        # DISQ_TPU_TRACE_JSONL honored at first use
+
+
+def _resolve_span_env() -> None:
+    global _env_resolved
+    if _env_resolved:
+        return
+    with _span_lock:
+        if _env_resolved:
+            return
+        _env_resolved = True
+        path = os.environ.get("DISQ_TPU_TRACE_JSONL")
+    if path and _span_sink is None:
+        start_span_log(path)
+
+
+def start_span_log(path: str) -> None:
+    """Start (or re-point) the JSONL span sink.  Each emitted span is
+    appended as one JSON line; a meta line maps this run's monotonic
+    clock to the epoch so timelines from multiple runs stay
+    separable."""
+    global _span_sink, _span_sink_path, _env_resolved
+    with _span_lock:
+        _env_resolved = True  # explicit call wins over the env knob
+        if _span_sink is not None:
+            if _span_sink_path == path:
+                return
+            _span_sink.close()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _span_sink = open(path, "a")
+        _span_sink_path = path
+        _span_sink.write(json.dumps({
+            "meta": 1, "run_id": RUN_ID, "pid": os.getpid(),
+            "epoch": time.time(), "mono": time.perf_counter(),
+        }) + "\n")
+        _span_sink.flush()
+        atexit.register(stop_span_log)
+
+
+def stop_span_log() -> None:
+    global _span_sink, _span_sink_path, _span_writes
+    with _span_lock:
+        if _span_sink is not None:
+            _span_sink.close()  # flushes any buffered tail
+            _span_sink = None
+            _span_sink_path = None
+            _span_writes = 0
+
+
+def span_log_path() -> Optional[str]:
+    with _span_lock:
+        return _span_sink_path
+
+
+def set_span_ring_capacity(n: int) -> None:
+    """Resize the in-memory span ring (keeps the most recent spans)."""
+    global _span_ring
+    with _span_lock:
+        _span_ring = deque(_span_ring, maxlen=max(1, int(n)))
+
+
+def spans() -> List[Dict[str, Any]]:
+    """Snapshot of the in-memory span ring, oldest first."""
+    with _span_lock:
+        return list(_span_ring)
+
+
+def reset_spans() -> None:
+    with _span_lock:
+        _span_ring.clear()
+
+
+def _emit_span(name: str, ts: float, dur: float,
+               labels: Dict[str, Any]) -> None:
+    global _span_writes
+    REGISTRY.histogram(name).observe(dur)
+    rec = {"ts": round(ts, 6), "dur": round(dur, 6), "name": name,
+           "run": RUN_ID, "labels": labels}
+    # Serialize outside the lock (unlocked sink check is benign: worst
+    # case one wasted dumps around a concurrent start/stop).
+    line = (json.dumps(rec, default=str) + "\n"
+            if _span_sink is not None else None)
+    with _span_lock:
+        dropped = len(_span_ring) == _span_ring.maxlen
+        _span_ring.append(rec)
+        if _span_sink is not None:
+            if line is None:
+                line = json.dumps(rec, default=str) + "\n"
+            _span_sink.write(line)
+            _span_writes += 1
+            if _span_writes >= _SINK_FLUSH_EVERY:
+                _span_sink.flush()
+                _span_writes = 0
+    if dropped:
+        REGISTRY.counter("telemetry.dropped_spans").inc()
+    logger.debug("span %s: %.4fs %s", name, dur, labels)
+
+
+@contextlib.contextmanager
+def span(name: str, **labels: Any) -> Iterator[None]:
+    """Timeline span: emits a ``{ts, dur, name, labels}`` event into the
+    ring/JSONL and books the duration in the ``name`` histogram (so
+    ``phase_report()`` and percentiles see it)."""
+    _resolve_span_env()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _emit_span(name, t0, time.perf_counter() - t0, labels)
+
+
+def record_span(name: str, seconds: float, **labels: Any) -> None:
+    """Book an already-measured duration as a span ending now (for
+    waits timed inline — e.g. the executor's ordered-emit stall — where
+    a context manager would nest a lock inside a condition wait)."""
+    _resolve_span_env()
+    now = time.perf_counter()
+    _emit_span(name, now - seconds, seconds, labels)
+
+
+def wrap_span(name: str, fn: Callable, **labels: Any) -> Callable:
+    """``fn`` wrapped in ``span(name, **labels)`` — for handing staged
+    callables (executor ``ShardTask.fetch``/``decode``) a per-shard
+    span without changing their signatures."""
+    def wrapped(*args: Any, **kwargs: Any):
+        with span(name, **labels):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Chrome/Perfetto trace_event export
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace_events(
+    span_list: Optional[List[Dict[str, Any]]] = None
+) -> List[Dict[str, Any]]:
+    """Spans as Chrome ``trace_event`` complete events (``ph: "X"``,
+    microsecond units).  Rows (``tid``) are shard ids when the span
+    carries one, so chrome://tracing / Perfetto renders the per-shard
+    waterfall directly."""
+    events = []
+    for s in (spans() if span_list is None else span_list):
+        labels = s.get("labels") or {}
+        tid = labels.get("shard")
+        try:
+            tid = int(tid)
+        except (TypeError, ValueError):
+            tid = 0
+        events.append({
+            "name": s["name"],
+            "ph": "X",
+            "ts": round(s["ts"] * 1e6, 3),
+            "dur": round(s["dur"] * 1e6, 3),
+            "pid": 1,
+            "tid": tid,
+            "args": labels,
+        })
+    return events
+
+
+def export_chrome_trace(path: str,
+                        span_list: Optional[List[Dict[str, Any]]] = None
+                        ) -> None:
+    with open(path, "w") as f:
+        # default=str: label values may be numpy scalars (voffsets)
+        json.dump({"traceEvents": chrome_trace_events(span_list),
+                   "displayTimeUnit": "ms"}, f, default=str)
+
+
+# ---------------------------------------------------------------------------
+# jax.profiler bridge + phase back-compat views
+# ---------------------------------------------------------------------------
+
 _lock = threading.Lock()
-_phases: List[Tuple[str, float]] = []
-_gauges: Dict[str, Dict[str, float]] = {}
 _trace_active = False
+
+# DISQ_TPU_TRACE_DIR and the jax import are resolved ONCE (first
+# trace_phase) — the old implementation re-read os.environ and re-ran
+# the import machinery on every call.
+_phase_env_resolved = False
+_trace_dir: Optional[str] = None
+_annotation_cls = None  # jax.profiler.TraceAnnotation, or None
+
+
+def _resolve_phase_env() -> None:
+    global _phase_env_resolved, _trace_dir, _annotation_cls
+    if _phase_env_resolved:
+        return
+    with _lock:
+        if _phase_env_resolved:
+            return
+        _trace_dir = os.environ.get("DISQ_TPU_TRACE_DIR")
+        try:
+            import jax
+
+            _annotation_cls = jax.profiler.TraceAnnotation
+        except ImportError:  # host-only deployments: timing still works
+            _annotation_cls = None
+        _phase_env_resolved = True
 
 
 def start_trace(trace_dir: str) -> None:
@@ -60,78 +729,84 @@ def stop_trace() -> None:
 
 
 @contextlib.contextmanager
-def trace_phase(name: str) -> Iterator[None]:
-    trace_dir = os.environ.get("DISQ_TPU_TRACE_DIR")
-    if trace_dir and not _trace_active:
-        start_trace(trace_dir)
-    try:
-        import jax
-
-        annotation = jax.profiler.TraceAnnotation(f"disq_tpu.{name}")
-    except ImportError:  # host-only deployments: timing still works
-        annotation = contextlib.nullcontext()
-
-    t0 = time.perf_counter()
-    try:
+def trace_phase(name: str, **labels: Any) -> Iterator[None]:
+    """``span`` + the jax.profiler bridge: the phase also appears on
+    the XLA timeline under a capture, and the first phase entered
+    auto-starts a ``DISQ_TPU_TRACE_DIR`` capture."""
+    _resolve_phase_env()
+    if _trace_dir and not _trace_active:
+        start_trace(_trace_dir)
+    annotation = (_annotation_cls(f"disq_tpu.{name}")
+                  if _annotation_cls is not None
+                  else contextlib.nullcontext())
+    with span(name, **labels):
         with annotation:
             yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            _phases.append((name, dt))
-        logger.debug("phase %s: %.4fs", name, dt)
 
 
-def record_phase(name: str, seconds: float) -> None:
-    """Book an already-measured duration as a phase (for waits that are
-    timed inline — e.g. the executor's ordered-emit stall — where
-    wrapping the wait in ``trace_phase`` would nest a lock inside a
-    condition wait)."""
-    with _lock:
-        _phases.append((name, seconds))
-    logger.debug("phase %s: %.4fs", name, seconds)
+def record_phase(name: str, seconds: float, **labels: Any) -> None:
+    """Back-compat alias for ``record_span``."""
+    record_span(name, seconds, **labels)
 
 
 def phase_report() -> Dict[str, Dict[str, float]]:
-    """Aggregated {phase: {calls, total_s}} since process start."""
+    """Aggregated ``{phase: {calls, total_s}}`` since process start —
+    a thin view over the registry's duration histograms (every span /
+    ``trace_phase`` books one)."""
     out: Dict[str, Dict[str, float]] = {}
-    with _lock:
-        snapshot = list(_phases)
-    for name, dt in snapshot:
-        agg = out.setdefault(name, {"calls": 0, "total_s": 0.0})
-        agg["calls"] += 1
-        agg["total_s"] += dt
-    for agg in out.values():
-        agg["total_s"] = round(agg["total_s"], 6)
+    with REGISTRY._lock:
+        for name, m in sorted(REGISTRY.metrics().items()):
+            if m.kind != "histogram":
+                continue
+            calls = m.count
+            if calls:
+                out[name] = {"calls": calls, "total_s": round(m.sum, 6)}
     return out
 
 
 def reset_phase_report() -> None:
-    with _lock:
-        _phases.clear()
+    """Zero the duration histograms (and the span ring — a fresh phase
+    report implies a fresh timeline)."""
+    with REGISTRY._lock:
+        for m in REGISTRY.metrics().values():
+            if m.kind == "histogram":
+                m._reset()
+    reset_spans()
 
 
-def observe_gauge(name: str, value: float) -> None:
-    """Record one sample of a level-style quantity (queue depth,
-    in-flight shard count): the report keeps max / last / sample
-    count rather than a sum — gauges are states, not durations."""
-    with _lock:
-        g = _gauges.get(name)
-        if g is None:
-            _gauges[name] = {"max": value, "last": value, "samples": 1}
-        else:
-            g["max"] = max(g["max"], value)
-            g["last"] = value
-            g["samples"] += 1
+def observe_gauge(name: str, value: float, **labels: Any) -> None:
+    """Record one sample of a level-style quantity — a thin wrapper
+    over ``gauge(name).observe(value)``."""
+    REGISTRY.gauge(name).observe(value, **labels)
 
 
 def gauge_report() -> Dict[str, Dict[str, float]]:
-    """Snapshot of every gauge observed since process start (or the
-    last ``reset_gauges``)."""
-    with _lock:
-        return {k: dict(v) for k, v in _gauges.items()}
+    """Snapshot of every unlabeled gauge series (legacy shape: ``max``
+    / ``last`` / ``samples``, now also ``min`` / ``mean``)."""
+    out: Dict[str, Dict[str, float]] = {}
+    with REGISTRY._lock:
+        for name, m in sorted(REGISTRY.metrics().items()):
+            if m.kind != "gauge":
+                continue
+            st = m.state()
+            if st is not None:
+                out[name] = st
+            else:
+                snap = m._snapshot()
+                if snap:
+                    out[name] = next(iter(snap.values()))
+    return out
 
 
 def reset_gauges() -> None:
-    with _lock:
-        _gauges.clear()
+    with REGISTRY._lock:
+        for m in REGISTRY.metrics().values():
+            if m.kind == "gauge":
+                m._reset()
+
+
+def reset_telemetry() -> None:
+    """Zero everything: registry, span ring (the JSONL sink, if open,
+    is left open — it is an append log)."""
+    REGISTRY.reset()
+    reset_spans()

@@ -676,16 +676,23 @@ class BcfSource:
         # inflate overlap across splits.
         split_size = getattr(self._storage, "_split_size",
                              128 * 1024 * 1024)
+        from disq_tpu.runtime.tracing import wrap_span
+
         tasks, shard_ctxs = [], []
         for i, s in enumerate(compute_path_splits(fs, path, split_size)):
             shard_ctx = ctx.for_shard(i)
             shard_ctxs.append(shard_ctx)
             tasks.append(ShardTask(
                 shard_id=i,
-                fetch=functools.partial(
-                    self._fetch_split_blocks, fs, path, s.start, s.end,
-                    length),
-                decode=self._inflate_fetched,
+                # Per-split timeline spans carrying shard id + byte range.
+                fetch=wrap_span(
+                    "bcf.split.fetch",
+                    functools.partial(
+                        self._fetch_split_blocks, fs, path, s.start, s.end,
+                        length),
+                    shard=i, start=s.start, end=s.end),
+                decode=wrap_span(
+                    "bcf.split.inflate", self._inflate_fetched, shard=i),
                 retrier=shard_ctx.retrier,
                 what=f"bcf-split{i}",
             ))

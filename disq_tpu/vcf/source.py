@@ -88,20 +88,25 @@ class VcfSource:
             tasks.append(self._make_task(
                 i, shard_ctx,
                 functools.partial(lines_for_split, fs, path, s.start, s.end),
-                header,
+                header, start=s.start, end=s.end,
             ))
         return self._emit_batches(tasks, shard_ctxs, header)
 
-    def _make_task(self, shard_id, shard_ctx, fetch, header):
+    def _make_task(self, shard_id, shard_ctx, fetch, header,
+                   start=None, end=None):
         from disq_tpu.runtime import ShardTask
+        from disq_tpu.runtime.tracing import span, wrap_span
 
         def decode(lines):
-            raw = [ln for ln in lines if ln and not ln.startswith(b"#")]
-            return parse_vcf_lines(raw, header.contig_names)
+            with span("vcf.split.decode", shard=shard_id):
+                raw = [ln for ln in lines if ln and not ln.startswith(b"#")]
+                return parse_vcf_lines(raw, header.contig_names)
 
         return ShardTask(
             shard_id=shard_id,
-            fetch=fetch,
+            # Per-split timeline spans carrying shard id + byte range.
+            fetch=wrap_span("vcf.split.fetch", fetch,
+                            shard=shard_id, start=start, end=end),
             decode=decode,
             retrier=shard_ctx.retrier if shard_ctx is not None else None,
             what=f"split{shard_id}",
@@ -161,7 +166,7 @@ class VcfSource:
                 i, shard_ctx,
                 functools.partial(self._bgzf_split_lines, fs, path,
                                   s.start, s.end, length, ctx=shard_ctx),
-                header,
+                header, start=s.start, end=s.end,
             ))
         return self._emit_batches(tasks, shard_ctxs, header)
 

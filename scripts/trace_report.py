@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""trace_report — replay a span JSONL into a per-shard waterfall.
+
+Input: the JSONL written by ``DISQ_TPU_TRACE_JSONL`` /
+``DisqOptions.span_log`` / ``start_span_log(path)`` — one
+``{ts, dur, name, run, labels}`` object per line (plus ``meta`` lines
+mapping each run's monotonic clock to the epoch).
+
+Output (stdout):
+
+- a per-shard **waterfall**: one row per shard, fetch/decode/stall
+  spans rendered as ``F``/``D``/``s`` bars on a common timeline;
+- **phase latency percentiles** (p50/p90/p99, computed exactly from
+  the raw span durations — no bucket estimation);
+- **stall attribution**: total span seconds by stage category (fetch
+  vs decode vs emit-stall vs retry/quarantine), answering "where does
+  wall-clock go";
+- **top-K straggler shards** by busy seconds.
+
+Usage::
+
+    python scripts/trace_report.py spans.jsonl [--top 5] [--width 80]
+        [--run RUN_ID] [--chrome out.json]
+
+``--chrome`` additionally converts the spans to Chrome/Perfetto
+``trace_event`` JSON (open in chrome://tracing or ui.perfetto.dev).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+# Stage attribution: span name prefix -> waterfall glyph / category.
+CATEGORIES = (
+    ("fetch", "F", ("executor.fetch",)),
+    ("decode", "D", ("executor.decode",)),
+    ("emit_stall", "s", ("executor.emit.stall",)),
+    ("retry", "r", ("retry.",)),
+    ("quarantine", "q", ("quarantine.",)),
+)
+
+
+def category_of(name: str) -> Optional[str]:
+    for cat, _glyph, prefixes in CATEGORIES:
+        for p in prefixes:
+            if name == p or (p.endswith(".") and name.startswith(p)):
+                return cat
+    return None
+
+
+def load_spans(path: str, run: Optional[str] = None):
+    """Spans + meta records from one JSONL, optionally filtered to one
+    run id (default: the LAST run seen — the usual 'report on the read
+    I just did' case when several runs appended to one file)."""
+    spans: List[Dict[str, Any]] = []
+    runs: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a crash
+            if rec.get("meta"):
+                if rec.get("run_id") and rec["run_id"] not in runs:
+                    runs.append(rec["run_id"])
+                continue
+            if "name" not in rec or "ts" not in rec:
+                continue
+            if rec.get("run") and rec["run"] not in runs:
+                runs.append(rec["run"])
+            spans.append(rec)
+    if run is None and runs:
+        run = runs[-1]
+    if run is not None:
+        spans = [s for s in spans if s.get("run") == run]
+    return spans, run, runs
+
+
+def percentile(sorted_vals: List[float], p: float) -> float:
+    """Exact linear-interpolated percentile over raw durations."""
+    if not sorted_vals:
+        return 0.0
+    k = (len(sorted_vals) - 1) * p / 100.0
+    lo = int(k)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (k - lo)
+
+
+def fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:8.3f}s"
+    return f"{v * 1e3:7.2f}ms"
+
+
+def build_waterfall(spans, width: int) -> List[str]:
+    """One row per shard; each executor-stage span paints its glyph
+    over its [start, end) slice of the common timeline. Later (higher
+    z) categories win inside one cell: stall over decode over fetch
+    would hide work, so painting order is fetch < decode < stall —
+    overlap shows the *later* pipeline stage."""
+    by_shard: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
+    t0, t1 = float("inf"), 0.0
+    for s in spans:
+        labels = s.get("labels") or {}
+        if "shard" not in labels or category_of(s["name"]) is None:
+            continue
+        try:
+            shard = int(labels["shard"])
+        except (TypeError, ValueError):
+            continue
+        by_shard[shard].append(s)
+        t0 = min(t0, s["ts"])
+        t1 = max(t1, s["ts"] + s["dur"])
+    if not by_shard or t1 <= t0:
+        return []
+    scale = width / (t1 - t0)
+    glyph = {cat: g for cat, g, _ in CATEGORIES}
+    z = {cat: i for i, (cat, _, _) in enumerate(CATEGORIES)}
+    rows = []
+    shard_w = max(len(str(k)) for k in by_shard)
+    for shard in sorted(by_shard):
+        cells = [" "] * width
+        depth = [-1] * width
+        busy = 0.0
+        for s in sorted(by_shard[shard], key=lambda s: s["ts"]):
+            cat = category_of(s["name"])
+            busy += s["dur"]
+            a = int((s["ts"] - t0) * scale)
+            b = max(a + 1, int((s["ts"] + s["dur"] - t0) * scale))
+            for i in range(a, min(b, width)):
+                if z[cat] >= depth[i]:
+                    cells[i] = glyph[cat]
+                    depth[i] = z[cat]
+        rows.append(
+            f"  shard {shard:>{shard_w}} |{''.join(cells)}| "
+            f"{fmt_s(busy).strip()} busy")
+    legend = "  " + " ".join(
+        f"{g}={cat}" for cat, g, _ in CATEGORIES)
+    span_line = (f"  timeline: {t1 - t0:.3f}s across "
+                 f"{len(by_shard)} shards")
+    return [span_line, legend, ""] + rows
+
+
+def report(spans, run, runs, top: int, width: int) -> str:
+    out: List[str] = []
+    if not spans:
+        return "no spans found (empty or filtered-out trace)\n"
+    out.append(f"run {run}  ({len(spans)} spans"
+               + (f"; file holds runs: {', '.join(runs)}" if len(runs) > 1
+                  else "") + ")")
+    out.append("")
+
+    # -- waterfall ---------------------------------------------------------
+    wf = build_waterfall(spans, width)
+    if wf:
+        out.append("per-shard waterfall")
+        out.extend(wf)
+        out.append("")
+
+    # -- phase latency percentiles ----------------------------------------
+    by_name: Dict[str, List[float]] = defaultdict(list)
+    for s in spans:
+        by_name[s["name"]].append(s["dur"])
+    out.append("phase latency percentiles")
+    name_w = max(len(n) for n in by_name)
+    out.append(f"  {'phase':<{name_w}}  {'calls':>6} {'total':>9} "
+               f"{'p50':>9} {'p90':>9} {'p99':>9} {'max':>9}")
+    for name in sorted(by_name, key=lambda n: -sum(by_name[n])):
+        durs = sorted(by_name[name])
+        out.append(
+            f"  {name:<{name_w}}  {len(durs):>6} {fmt_s(sum(durs))} "
+            f"{fmt_s(percentile(durs, 50))} {fmt_s(percentile(durs, 90))} "
+            f"{fmt_s(percentile(durs, 99))} {fmt_s(durs[-1])}")
+    out.append("")
+
+    # -- stall attribution -------------------------------------------------
+    by_cat: Dict[str, float] = defaultdict(float)
+    for s in spans:
+        cat = category_of(s["name"])
+        if cat is not None:
+            by_cat[cat] += s["dur"]
+    if by_cat:
+        total = sum(by_cat.values())
+        out.append("stall attribution (span-seconds by stage)")
+        for cat, _g, _p in CATEGORIES:
+            if cat in by_cat:
+                v = by_cat[cat]
+                out.append(f"  {cat:<11} {fmt_s(v)}  "
+                           f"{v / total * 100:5.1f}%")
+        out.append("")
+
+    # -- straggler shards --------------------------------------------------
+    busy: Dict[int, float] = defaultdict(float)
+    for s in spans:
+        labels = s.get("labels") or {}
+        if "shard" in labels and category_of(s["name"]) is not None:
+            try:
+                busy[int(labels["shard"])] += s["dur"]
+            except (TypeError, ValueError):
+                continue
+    if busy:
+        out.append(f"top-{top} straggler shards (busy seconds)")
+        mean = sum(busy.values()) / len(busy)
+        for shard, v in sorted(busy.items(), key=lambda kv: -kv[1])[:top]:
+            out.append(f"  shard {shard:<6} {fmt_s(v)}  "
+                       f"{v / mean:5.2f}x mean")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-shard waterfall + latency report from a "
+                    "disq_tpu span JSONL")
+    ap.add_argument("jsonl", help="span log written via "
+                    "DISQ_TPU_TRACE_JSONL / DisqOptions.span_log")
+    ap.add_argument("--top", type=int, default=5,
+                    help="straggler shards to list (default 5)")
+    ap.add_argument("--width", type=int, default=72,
+                    help="waterfall width in columns (default 72)")
+    ap.add_argument("--run", default=None,
+                    help="run id to report (default: last run in file)")
+    ap.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="also write Chrome/Perfetto trace_event JSON")
+    args = ap.parse_args(argv)
+
+    spans, run, runs = load_spans(args.jsonl, args.run)
+    sys.stdout.write(report(spans, run, runs, args.top, args.width))
+    if args.chrome:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from disq_tpu.runtime.tracing import export_chrome_trace
+
+        export_chrome_trace(args.chrome, spans)
+        sys.stdout.write(f"chrome trace written to {args.chrome}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,10 +100,36 @@ def test_range_reads_and_prefetch(bam_url):
         assert f.read(5000) == raw[1000:6000]
     assert fs.stats.range_requests > 0
     assert fs.stats.prefetch_issued > 0
-    # second scan over cached blocks costs no new requests
+    # Cache efficacy is visible: the scans above paid misses for their
+    # first touch of each block...
+    assert fs.stats.cache_misses > 0
+    # second scan over cached blocks costs no new requests — every
+    # block is a cache hit
     before = fs.stats.range_requests
+    hits_before = fs.stats.cache_hits
     assert fs.read_range(url, 30_000, 40_000) == raw[30_000:70_000]
     assert fs.stats.range_requests == before
+    assert fs.stats.cache_hits > hits_before
+    # the mirrored registry counters moved with the stats
+    from disq_tpu.runtime.tracing import counter
+
+    assert counter("fsw.http.cache.hits").total() >= fs.stats.cache_hits
+    assert counter("fsw.http.cache.misses").total() >= fs.stats.cache_misses
+
+
+def test_lru_eviction_counted(bam_url):
+    """A scan through more blocks than the LRU holds must evict — and
+    the eviction counter must say so."""
+    url, raw = bam_url
+    fs = HttpFileSystemWrapper(
+        block_size=16 * 1024, prefetch=False, max_cached_blocks=2)
+    assert fs.exists(url)
+    fs.read_range(url, 0, 16 * 1024 * 6)  # 6 blocks through a 2-slot LRU
+    assert fs.stats.cache_evictions >= 4
+    assert fs.stats.cache_misses >= 6
+    from disq_tpu.runtime.tracing import counter
+
+    assert counter("fsw.http.cache.evictions").total() >= 4
 
 
 def test_bam_source_end_to_end_over_http(bam_url, tmp_path):

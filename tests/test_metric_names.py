@@ -1,0 +1,61 @@
+"""Tier-1 guard for the metric-name registry lint
+(``scripts/check_metrics.py``): every metric/span name literal in
+``disq_tpu/`` must follow the dotted taxonomy and match the README
+metric table exactly, so a rename (or a new undocumented metric) is a
+deliberate, reviewed change — never drift."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_metrics.py")
+
+
+def test_metric_names_lint_passes():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT], capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"metric-name lint failed:\n{proc.stdout}{proc.stderr}")
+    assert "OK" in proc.stdout
+
+
+def test_lint_catches_undocumented_name(tmp_path, monkeypatch):
+    """The drift check actually fires: a code tree using a metric the
+    README does not document must fail."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_metrics as cm
+    finally:
+        sys.path.pop(0)
+    code = tmp_path / "disq_tpu"
+    code.mkdir()
+    (code / "mod.py").write_text(
+        'from disq_tpu.runtime.tracing import counter\n'
+        'counter("executor.not_in_readme").inc()\n')
+    (tmp_path / "README.md").write_text(
+        "<!-- metrics:begin -->\n| `executor.fetch` |\n"
+        "<!-- metrics:end -->\n")
+    monkeypatch.setattr(cm, "CODE_ROOT", str(code))
+    monkeypatch.setattr(cm, "README", str(tmp_path / "README.md"))
+    assert cm.main() == 1
+
+
+def test_lint_catches_bad_prefix_and_kind_conflict(tmp_path, monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_metrics as cm
+    finally:
+        sys.path.pop(0)
+    code = tmp_path / "disq_tpu"
+    code.mkdir()
+    (code / "mod.py").write_text(
+        'counter("mystery.metric").inc()\n'          # bad prefix
+        'counter("executor.fetch").inc()\n'          # kind conflict:
+        'with span("executor.fetch"): pass\n')       # counter vs timing
+    (tmp_path / "README.md").write_text(
+        "<!-- metrics:begin -->\n| `mystery.metric` | `executor.fetch` |\n"
+        "<!-- metrics:end -->\n")
+    monkeypatch.setattr(cm, "CODE_ROOT", str(code))
+    monkeypatch.setattr(cm, "README", str(tmp_path / "README.md"))
+    assert cm.main() == 1
