@@ -297,6 +297,19 @@ class ReadsStorage:
         self._options = self._options.with_executor(n, prefetch_shards)
         return self
 
+    def writer_workers(self, n: int,
+                       prefetch_shards: Optional[int] = None
+                       ) -> "ReadsStorage":
+        """Size the shard write pipeline (``runtime/executor.py``):
+        ``n`` workers overlap record encode, BGZF deflate and part
+        staging across write shards in every sink (BAM/SAM/CRAM single
+        and multiple); at most ``prefetch_shards`` shards run ahead of
+        the ordered emit (None ⇒ ``2 × n``). ``n=1`` (the default) is
+        the sequential-compatible inline path. Written files (and
+        merged indexes) are byte-identical for any ``n``."""
+        self._options = self._options.with_writer(n, prefetch_shards)
+        return self
+
     def span_log(self, path: str) -> "ReadsStorage":
         """Point the process-wide JSONL span sink at ``path`` when a
         read through this storage starts (the input of
@@ -382,6 +395,14 @@ class VariantsStorage:
         BGZF-split VCF, BCF block inflate) — see
         ``ReadsStorage.executor_workers``."""
         self._options = self._options.with_executor(n, prefetch_shards)
+        return self
+
+    def writer_workers(self, n: int,
+                       prefetch_shards: Optional[int] = None
+                       ) -> "VariantsStorage":
+        """Shard write-pipeline sizing for variant writes (VCF plain /
+        gzip / BGZF, BCF) — see ``ReadsStorage.writer_workers``."""
+        self._options = self._options.with_writer(n, prefetch_shards)
         return self
 
     def span_log(self, path: str) -> "VariantsStorage":

@@ -15,6 +15,14 @@ payload per block), so ``voffset(u) = (block_comp_start[u // 65280] << 16)
 | (u % 65280)`` is array arithmetic over the record-offset vector, not a
 per-record stream query. This is what makes index construction a
 "segmented scan over sorted virtual offsets" (BASELINE.json north star).
+
+Shards run through the shard write pipeline
+(``runtime/executor.ShardWritePipeline``): encode (slice + record
+encode) → deflate (BGZF + voffset/index arithmetic) → stage (durable
+part + fragment writes), overlapped across shards at
+``DisqOptions.writer_workers > 1`` with byte-identical output; the
+deterministic per-shard bytes + ordered concat of the part-merge
+protocol are what make that safe.
 """
 
 from __future__ import annotations
@@ -164,40 +172,52 @@ class BamSink:
                 manifest.finish()
             fs.delete(temp_dir, recursive=True)
 
-    def _write_one_part(
-        self, fs, header, batch, temp_dir, bounds, write_bai, write_sbi, k,
-        frag_cache=None,
-    ) -> dict:
-        """Encode + deflate + stage shard ``k``; returns the shard's
-        manifest record (part path/length + index-fragment locations).
-        Fragments land in ``frag_cache`` in memory and are additionally
-        pickled next to the part only when checkpointing (frag_cache is
-        None ⇒ persist — the manifest path always resumes from disk)."""
-        from disq_tpu.runtime import check_voffsets, debug_enabled
+    # -- pipeline stage bodies (encode → deflate → stage) -------------------
 
+    def _encode_shard(self, batch, bounds, k):
+        """Stage 1 (CPU): slice shard ``k`` and encode its records."""
         part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
         blob, rec_offs = encode_records_with_offsets(part)
+        return part, blob, rec_offs
+
+    def _deflate_shard(self, header, write_bai, write_sbi, payload):
+        """Stage 2 (native-threaded CPU): canonical BGZF deflate,
+        vectorized voffset arithmetic, and index-fragment build."""
+        from disq_tpu.runtime import check_voffsets, debug_enabled
+
+        part, blob, rec_offs = payload
         comp, voffs, end_voffs = bgzf_compress_with_voffsets(blob, rec_offs)
         if debug_enabled():
             check_voffsets(voffs)
-        part_path = os.path.join(temp_dir, f"part-{k:05d}")
-        fs.write_all(part_path, comp)
-        info = {"part": part_path, "len": len(comp), "sbi": None, "bai": None}
-        persist = frag_cache is None
         sbi_frag = bai_frag = None
         if write_sbi:
             sbi_frag = SbiIndex.build(
                 voffs, int(end_voffs[-1]) if part.count else 0,
                 0, granularity=SBI_GRANULARITY,
             )
-            info["sbi"] = part_path + ".sbi-frag"
-            if persist:
-                fs.write_all(info["sbi"], _pickle_dumps(sbi_frag))
         if write_bai:
             bai_frag = build_bai(
                 part.refid, part.pos, part.alignment_ends(),
                 part.flag, voffs, end_voffs, header.n_ref,
             )
+        return comp, sbi_frag, bai_frag
+
+    def _stage_shard(self, fs, temp_dir, k, frag_cache, payload) -> dict:
+        """Stage 3 (I/O): durably write the part (and pickled index
+        fragments when checkpointing); returns the shard's manifest
+        record. Fragments land in ``frag_cache`` in memory and are
+        pickled beside the part only when checkpointing (frag_cache is
+        None ⇒ persist — the manifest path always resumes from disk)."""
+        comp, sbi_frag, bai_frag = payload
+        part_path = os.path.join(temp_dir, f"part-{k:05d}")
+        fs.write_all(part_path, comp)
+        info = {"part": part_path, "len": len(comp), "sbi": None, "bai": None}
+        persist = frag_cache is None
+        if sbi_frag is not None:
+            info["sbi"] = part_path + ".sbi-frag"
+            if persist:
+                fs.write_all(info["sbi"], _pickle_dumps(sbi_frag))
+        if bai_frag is not None:
             info["bai"] = part_path + ".bai-frag"
             if persist:
                 fs.write_all(info["bai"], _pickle_dumps(bai_frag))
@@ -205,19 +225,69 @@ class BamSink:
             frag_cache[k] = (sbi_frag, bai_frag)
         return info
 
+    def _write_one_part(
+        self, fs, header, batch, temp_dir, bounds, write_bai, write_sbi, k,
+        frag_cache=None,
+    ) -> dict:
+        """Whole-shard unit (encode + deflate + stage in one call) —
+        the sequential manifest path's work function, and the
+        composition the pipeline stages split apart."""
+        from disq_tpu.runtime.tracing import span
+
+        with span("bam.write.encode", shard=k):
+            payload = self._encode_shard(batch, bounds, k)
+        with span("bam.write.deflate", shard=k):
+            payload = self._deflate_shard(header, write_bai, write_sbi,
+                                          payload)
+        with span("bam.write.stage", shard=k):
+            return self._stage_shard(fs, temp_dir, k, frag_cache, payload)
+
+    def _make_write_task(self, fs, header, batch, temp_dir, bounds,
+                         write_bai, write_sbi, k, frag_cache):
+        from disq_tpu.runtime.executor import (
+            WriteShardTask,
+            write_retrier_for_storage,
+        )
+        from disq_tpu.runtime.tracing import wrap_span
+
+        return WriteShardTask(
+            shard_id=k,
+            encode=wrap_span(
+                "bam.write.encode",
+                lambda: self._encode_shard(batch, bounds, k), shard=k),
+            deflate=wrap_span(
+                "bam.write.deflate",
+                lambda p: self._deflate_shard(
+                    header, write_bai, write_sbi, p), shard=k),
+            stage=wrap_span(
+                "bam.write.stage",
+                lambda p: self._stage_shard(
+                    fs, temp_dir, k, frag_cache, p), shard=k),
+            retrier=write_retrier_for_storage(self._storage),
+            what="bam.part",
+        )
+
     def _write_parts_and_merge(
         self, fs, header, batch, path, temp_dir, n_shards, bounds,
         write_bai, write_sbi, manifest=None,
     ) -> None:
         from disq_tpu.runtime import trace_phase
+        from disq_tpu.runtime.executor import (
+            run_write_stage,
+            write_retrier_for_storage,
+            writer_for_storage,
+        )
 
-        frag_cache: dict = {}
+        pipeline = writer_for_storage(self._storage)
+        # Checkpointed: fragments must survive the process, so each
+        # shard pickles them beside its part (frag_cache unused);
+        # resumed shards reload from disk below.
+        frag_cache = None if manifest is not None else {}
 
         with trace_phase("bam.write.parts"):
-            if manifest is not None:
-                # Checkpointed: fragments must survive the process, so
-                # each shard pickles them beside its part (frag_cache
-                # unused); resumed shards reload from disk below.
+            if manifest is not None and pipeline.workers == 1:
+                # Historical sequential-checkpoint path: run_stage owns
+                # skip/retry/RuntimeError semantics shard by shard.
                 infos = manifest.run_stage(
                     "bam.parts", n_shards,
                     lambda k: self._write_one_part(
@@ -226,18 +296,18 @@ class BamSink:
                     ),
                 )
             else:
-                infos = [
-                    self._write_one_part(
+                infos = run_write_stage(
+                    pipeline, n_shards,
+                    lambda k: self._make_write_task(
                         fs, header, batch, temp_dir, bounds,
-                        write_bai, write_sbi, k, frag_cache=frag_cache,
-                    )
-                    for k in range(n_shards)
-                ]
+                        write_bai, write_sbi, k, frag_cache),
+                    manifest=manifest, stage_name="bam.parts",
+                )
         part_paths = [i["part"] for i in infos]
         part_lens = [i["len"] for i in infos]
 
         def _frag(k: int, which: int, key: str):
-            if k in frag_cache:
+            if frag_cache is not None and k in frag_cache:
                 return frag_cache[k][which]
             return _pickle_loads(fs.read_all(infos[k][key]))
 
@@ -249,13 +319,20 @@ class BamSink:
         ]
 
         # Driver side: header-only BGZF prefix, concat, terminator.
+        # Every durable driver write runs under the same transient
+        # retry budget the staged parts get (atomic create makes a
+        # retried write/concat safe).
+        driver = write_retrier_for_storage(self._storage)
         with trace_phase("bam.write.merge"):
             header_comp = compress_to_bgzf(header.to_bam_bytes(), with_terminator=False)
             header_path = os.path.join(temp_dir, "_header")
-            fs.write_all(header_path, header_comp)
+            driver.call(fs.write_all, header_path, header_comp,
+                        what="bam.merge")
             term_path = os.path.join(temp_dir, "_terminator")
-            fs.write_all(term_path, BGZF_EOF_MARKER)
-            fs.concat([header_path] + part_paths + [term_path], path)
+            driver.call(fs.write_all, term_path, BGZF_EOF_MARKER,
+                        what="bam.merge")
+            driver.call(fs.concat, [header_path] + part_paths + [term_path],
+                        path, what="bam.merge")
 
         part_starts = np.zeros(len(part_lens) + 1, dtype=np.int64)
         np.cumsum(part_lens, out=part_starts[1:])
@@ -263,10 +340,12 @@ class BamSink:
         file_length = fs.get_file_length(path)
         if write_sbi:
             merged = SbiIndex.merge(sbi_frags, list(part_starts), file_length)
-            fs.write_all(path + ".sbi", merged.to_bytes())
+            driver.call(fs.write_all, path + ".sbi", merged.to_bytes(),
+                        what="bam.merge")
         if write_bai:
             merged_bai = merge_bai_fragments(bai_frags, list(part_starts))
-            fs.write_all(path + ".bai", merged_bai.to_bytes())
+            driver.call(fs.write_all, path + ".bai", merged_bai.to_bytes(),
+                        what="bam.merge")
 
 
 class BamSinkMultiple:
@@ -277,13 +356,40 @@ class BamSinkMultiple:
         self._storage = storage
 
     def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        from disq_tpu.runtime.executor import (
+            WriteShardTask,
+            run_write_stage,
+            write_retrier_for_storage,
+            writer_for_storage,
+        )
+        from disq_tpu.runtime.tracing import wrap_span
+
         fs, path = resolve_path(path)
         header: SamHeader = dataset.header
         batch: ReadBatch = dataset.reads
         n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         header_bytes = header.to_bam_bytes()
-        for k in range(n_shards):
-            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-            data = compress_to_bgzf(header_bytes + encode_records(part))
-            fs.write_all(os.path.join(path, f"part-r-{k:05d}.bam"), data)
+
+        def make_task(k):
+            def encode():
+                part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+                return header_bytes + encode_records(part)
+
+            def stage(data):
+                p = os.path.join(path, f"part-r-{k:05d}.bam")
+                fs.write_all(p, data)
+                return p
+
+            return WriteShardTask(
+                shard_id=k,
+                encode=wrap_span("bam.write.encode", encode, shard=k),
+                deflate=wrap_span("bam.write.deflate", compress_to_bgzf,
+                                  shard=k),
+                stage=wrap_span("bam.write.stage", stage, shard=k),
+                retrier=write_retrier_for_storage(self._storage),
+                what="bam.part",
+            )
+
+        run_write_stage(writer_for_storage(self._storage), n_shards,
+                        make_task)

@@ -37,6 +37,53 @@ MAX_SLICE_RECORDS = 10_000
 from disq_tpu.cram.refsource import fetcher_for_storage as _ref_fetcher
 
 
+def run_cram_write_stage(storage, fs, batch, bounds, n_shards, ref_fetch,
+                         part_path_for, assemble=None):
+    """Shared shard fan-out for both CRAM sinks: container encoding
+    (the dominant CPU cost — CRAM codecs compress inside
+    ``encode_container``, so there is no separate deflate stage) runs
+    on the write pipeline's encode workers while staged parts stream
+    out on its I/O workers. ``assemble(part_bytes)`` optionally wraps
+    each shard's container stream into a complete file (MULTIPLE
+    cardinality). Per-shard ``record_counter_base`` is the shard's
+    absolute record start, so output is worker-count invariant."""
+    from disq_tpu.runtime.executor import (
+        WriteShardTask,
+        run_write_stage,
+        write_retrier_for_storage,
+        writer_for_storage,
+    )
+    from disq_tpu.runtime.tracing import wrap_span
+
+    def make_task(k):
+        def encode():
+            lo, hi = int(bounds[k]), int(bounds[k + 1])
+            part_bytes, entries = encode_part(
+                batch.slice(lo, hi), lo if assemble is None else 0,
+                ref_fetch,
+            )
+            if assemble is not None:
+                part_bytes = assemble(part_bytes)
+            return part_bytes, entries
+
+        def stage(payload):
+            part_bytes, entries = payload
+            p = part_path_for(k)
+            fs.write_all(p, part_bytes)
+            return {"part": p, "len": len(part_bytes),
+                    "crai": CraiIndex(entries)}
+
+        return WriteShardTask(
+            shard_id=k,
+            encode=wrap_span("cram.write.encode", encode, shard=k),
+            stage=wrap_span("cram.write.stage", stage, shard=k),
+            retrier=write_retrier_for_storage(storage),
+            what="cram.part",
+        )
+
+    return run_write_stage(writer_for_storage(storage), n_shards, make_task)
+
+
 def _header_container(header) -> bytes:
     """First container: the SAM header in a FILE_HEADER block."""
     text = header.text.encode()
@@ -95,6 +142,8 @@ class CramSink:
         self._storage = storage
 
     def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        from disq_tpu.runtime.executor import write_retrier_for_storage
+
         fs, path = resolve_path(path)
         header = dataset.header
         batch: ReadBatch = dataset.reads
@@ -110,28 +159,29 @@ class CramSink:
         fs.mkdirs(temp_dir)
         try:
             prefix = file_definition() + _header_container(header)
-            part_paths, part_lens, frags = [], [], []
-            for k in range(n_shards):
-                lo, hi = int(bounds[k]), int(bounds[k + 1])
-                part_bytes, entries = encode_part(
-                    batch.slice(lo, hi), lo, ref_fetch
-                )
-                p = os.path.join(temp_dir, f"part-{k:05d}")
-                fs.write_all(p, part_bytes)
-                part_paths.append(p)
-                part_lens.append(len(part_bytes))
-                frags.append(CraiIndex(entries))
+            infos = run_cram_write_stage(
+                self._storage, fs, batch, bounds, n_shards, ref_fetch,
+                lambda k: os.path.join(temp_dir, f"part-{k:05d}"),
+            )
+            part_paths = [i["part"] for i in infos]
+            part_lens = [i["len"] for i in infos]
+            frags = [i["crai"] for i in infos]
+            driver = write_retrier_for_storage(self._storage)
             prefix_path = os.path.join(temp_dir, "_prefix")
-            fs.write_all(prefix_path, prefix)
+            driver.call(fs.write_all, prefix_path, prefix,
+                        what="cram.merge")
             eof_path = os.path.join(temp_dir, "_eof")
-            fs.write_all(eof_path, EOF_CONTAINER)
-            fs.concat([prefix_path] + part_paths + [eof_path], path)
+            driver.call(fs.write_all, eof_path, EOF_CONTAINER,
+                        what="cram.merge")
+            driver.call(fs.concat, [prefix_path] + part_paths + [eof_path],
+                        path, what="cram.merge")
             if write_crai:
                 part_starts = np.zeros(len(part_lens), dtype=np.int64)
                 np.cumsum(part_lens[:-1], out=part_starts[1:])
                 part_starts += len(prefix)
                 merged = CraiIndex.merge(frags, list(part_starts))
-                fs.write_all(path + ".crai", merged.to_bytes())
+                driver.call(fs.write_all, path + ".crai",
+                            merged.to_bytes(), what="cram.merge")
         finally:
             fs.delete(temp_dir, recursive=True)
 
@@ -150,10 +200,8 @@ class CramSinkMultiple:
         n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         prefix = file_definition() + _header_container(header)
-        for k in range(n_shards):
-            lo, hi = int(bounds[k]), int(bounds[k + 1])
-            part_bytes, _ = encode_part(batch.slice(lo, hi), 0, ref_fetch)
-            fs.write_all(
-                os.path.join(path, f"part-r-{k:05d}.cram"),
-                prefix + part_bytes + EOF_CONTAINER,
-            )
+        run_cram_write_stage(
+            self._storage, fs, batch, bounds, n_shards, ref_fetch,
+            lambda k: os.path.join(path, f"part-r-{k:05d}.cram"),
+            assemble=lambda part_bytes: prefix + part_bytes + EOF_CONTAINER,
+        )

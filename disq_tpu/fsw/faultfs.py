@@ -26,6 +26,16 @@ Targeting: each spec can match by path substring, by a Bernoulli
 read), and by ``offset`` (reads covering an absolute byte). ``times``
 bounds how often a spec fires (-1 = unlimited).
 
+Each spec also targets one direction via ``op``: ``"read"`` (the
+default — existing schedules keep their exact meaning) fires on
+``read_range``; ``"write"`` fires on the write-side entry points
+(``write_all`` / ``create`` / ``concat``), so the parallel write
+pipeline's retry + manifest-resume behavior is deterministically
+testable. ``write_all`` supports every kind (``truncate`` /
+``bitflip`` mutate the bytes *before* they are durably staged — the
+model for a partial or corrupted upload); ``create`` and ``concat``
+support the pre-op kinds (``transient`` / ``stall``).
+
 All reads — including ``open()`` streams — are routed through
 ``read_range``, so a single injection point covers header reads, block
 walks, and bulk staging alike. The ``injected`` log records every fired
@@ -58,10 +68,13 @@ class FaultSpec:
     stall_s: float = 0.0            # kind="stall"
     truncate_bytes: int = 1         # kind="truncate": bytes dropped from tail
     bit: int = 0                    # kind="bitflip": bit index 0..7
+    op: str = "read"                # direction: "read" | "write"
 
     def __post_init__(self) -> None:
         if self.kind not in ("transient", "stall", "truncate", "bitflip"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.op not in ("read", "write"):
+            raise ValueError(f"unknown fault op {self.op!r}")
         if self.kind == "bitflip" and self.offset is None:
             raise ValueError("bitflip faults need an absolute byte offset")
 
@@ -119,8 +132,11 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
         return path[len(prefix):] if path.startswith(prefix) else path
 
     def _spec_matches(
-        self, i: int, spec: FaultSpec, path: str, start: int, length: int
+        self, i: int, spec: FaultSpec, path: str, start: int, length: int,
+        op: str = "read_range",
     ) -> bool:
+        if spec.op != ("read" if op == "read_range" else "write"):
+            return False
         if spec.path_substr and spec.path_substr not in path:
             return False
         if spec.offset is not None and not (
@@ -147,22 +163,25 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
         return True
 
     def _apply_faults(self, path: str, start: int, length: int,
-                      data: Optional[bytes], call: int) -> Optional[bytes]:
-        """Run the schedule for one read. ``data=None`` = pre-read phase
-        (raise/stall); bytes = post-read phase (mutate)."""
+                      data: Optional[bytes], call: int,
+                      op: str = "read_range") -> Optional[bytes]:
+        """Run the schedule for one call. ``data=None`` = pre-op phase
+        (raise/stall); bytes = mutation phase (post-read for reads,
+        pre-commit for writes — the staged bytes are damaged before
+        they land)."""
         for i, spec in enumerate(self.faults):
             pre = spec.kind in ("transient", "stall")
             if pre != (data is None):
                 continue
-            if not self._spec_matches(i, spec, path, start, length):
+            if not self._spec_matches(i, spec, path, start, length, op):
                 continue
             self._fired[i] += 1
             self.injected.append(
-                _Injection(spec.kind, "read_range", path, start, length, call)
+                _Injection(spec.kind, op, path, start, length, call)
             )
             if spec.kind == "transient":
                 raise TransientIOError(
-                    f"injected transient fault #{call} on {path} "
+                    f"injected transient fault #{call} on {op} {path} "
                     f"[{start}, {start + length})"
                 )
             if spec.kind == "stall":
@@ -207,8 +226,43 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
     def get_file_length(self, path: str) -> int:
         return self.inner.get_file_length(self._strip(path))
 
+    def _pre_write_faults(self, real: str, length: int, op: str) -> None:
+        """Pre-op phase for a write-side call: transient raises and
+        stall booking under the mutex, sleeping outside it."""
+        with self._mutex:
+            self._calls += 1
+            call = self._calls
+            self._apply_faults(real, 0, length, None, call, op=op)
+            stall, self._pending_stall = self._pending_stall, 0.0
+        if stall:
+            self._sleep(stall)
+
+    def write_all(self, path: str, data: bytes) -> None:
+        real = self._strip(path)
+        with self._mutex:
+            self._calls += 1
+            call = self._calls
+            self._apply_faults(real, 0, len(data), None, call,
+                               op="write_all")
+            # Mutation phase BEFORE the durable write: a truncate or
+            # bitflip here models a partial/corrupted upload that the
+            # store nevertheless committed.
+            data = self._apply_faults(real, 0, len(data), data, call,
+                                      op="write_all")
+            stall, self._pending_stall = self._pending_stall, 0.0
+        if stall:
+            self._sleep(stall)
+        self.inner.write_all(real, data)
+
     def create(self, path: str) -> BinaryIO:
-        return self.inner.create(self._strip(path))
+        real = self._strip(path)
+        self._pre_write_faults(real, 0, "create")
+        return self.inner.create(real)
+
+    def concat(self, parts, target: str) -> None:
+        real = self._strip(target)
+        self._pre_write_faults(real, 0, "concat")
+        self.inner.concat([self._strip(p) for p in parts], real)
 
     def list_directory(self, path: str) -> List[str]:
         return self.inner.list_directory(self._strip(path))

@@ -16,7 +16,10 @@ protocol. JAX has no task retry, so the equivalents here are:
 - ``executor`` — the shard-pipeline executor: a bounded three-stage
   fetch → decode → ordered-emit pipeline shared by every format
   source, overlapping range-reads, inflate and record decode across
-  splits (``DisqOptions.executor_workers`` / ``prefetch_shards``).
+  splits (``DisqOptions.executor_workers`` / ``prefetch_shards``);
+  plus its write-direction twin ``ShardWritePipeline`` (encode →
+  deflate → stage, ``DisqOptions.writer_workers``) shared by every
+  format sink.
 - ``counters`` — per-shard counters (records, blocks, bytes,
   compression ratio) returned per shard and reduced.
 - ``tracing`` — the structured telemetry layer: a labeled
@@ -51,7 +54,14 @@ from disq_tpu.runtime.executor import (  # noqa: F401
     ShardPipelineExecutor,
     ShardResult,
     ShardTask,
+    ShardWritePipeline,
+    WriteShardResult,
+    WriteShardTask,
+    WriterStats,
     executor_for_storage,
+    run_write_stage,
+    write_retrier_for_storage,
+    writer_for_storage,
 )
 from disq_tpu.runtime.manifest import (  # noqa: F401
     QuarantineManifest,

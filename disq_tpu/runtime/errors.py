@@ -76,6 +76,12 @@ class DisqOptions:
     ``prefetch_shards`` splits in flight past the emit frontier
     (None ⇒ ``2 × executor_workers``).
 
+    ``writer_workers`` / ``writer_prefetch_shards`` are the write-side
+    mirror: they size the ``ShardWritePipeline`` every sink runs its
+    shards through, overlapping record encode, BGZF deflate and part
+    staging across shards. Output is byte-identical at any width; 1
+    (the default) is the inline sequential path.
+
     ``span_log`` points the *process-wide* JSONL span sink at the
     given path when a read through this storage starts (per-shard
     fetch/decode, retries, quarantine writes — the file
@@ -93,6 +99,8 @@ class DisqOptions:
     quarantine_dir: Optional[str] = None
     executor_workers: int = 1
     prefetch_shards: Optional[int] = None
+    writer_workers: int = 1
+    writer_prefetch_shards: Optional[int] = None
     span_log: Optional[str] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
@@ -104,6 +112,13 @@ class DisqOptions:
             raise ValueError(f"executor_workers must be >= 1, got {workers}")
         return replace(self, executor_workers=int(workers),
                        prefetch_shards=prefetch_shards)
+
+    def with_writer(self, workers: int,
+                    prefetch_shards: Optional[int] = None) -> "DisqOptions":
+        if workers < 1:
+            raise ValueError(f"writer_workers must be >= 1, got {workers}")
+        return replace(self, writer_workers=int(workers),
+                       writer_prefetch_shards=prefetch_shards)
 
 
 class CorruptBlockError(ValueError):

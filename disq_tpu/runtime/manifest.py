@@ -19,6 +19,7 @@ import hashlib
 import json
 import os
 import tempfile
+import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 FORMAT_VERSION = 1
@@ -34,6 +35,10 @@ class StageManifest:
 
     def __init__(self, path: str, params: Optional[Dict[str, Any]] = None):
         self.path = path
+        # The parallel write pipeline records shard completion from its
+        # stage workers as each shard's part lands — mark_done (ledger
+        # mutation + atomic flush) must not interleave across threads.
+        self._lock = threading.RLock()
         self._state: Dict[str, Any] = {
             "version": FORMAT_VERSION,
             "params": params or {},
@@ -77,17 +82,21 @@ class StageManifest:
         return self._state["stages"].setdefault(stage, {"shards": {}})
 
     def is_done(self, stage: str, shard_id: int) -> bool:
-        return str(shard_id) in self._stage(stage)["shards"]
+        with self._lock:
+            return str(shard_id) in self._stage(stage)["shards"]
 
     def shard_info(self, stage: str, shard_id: int) -> Any:
-        return self._stage(stage)["shards"][str(shard_id)]
+        with self._lock:
+            return self._stage(stage)["shards"][str(shard_id)]
 
     def mark_done(self, stage: str, shard_id: int, info: Any = None) -> None:
-        self._stage(stage)["shards"][str(shard_id)] = info
-        self._flush()
+        with self._lock:
+            self._stage(stage)["shards"][str(shard_id)] = info
+            self._flush()
 
     def completed_shards(self, stage: str) -> List[int]:
-        return sorted(int(k) for k in self._stage(stage)["shards"])
+        with self._lock:
+            return sorted(int(k) for k in self._stage(stage)["shards"])
 
     # -- stage execution -------------------------------------------------
 

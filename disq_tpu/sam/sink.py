@@ -20,6 +20,44 @@ from disq_tpu.sam.text import batch_to_sam_lines
 from disq_tpu.util import shard_bounds
 
 
+def _run_sam_shards(storage, fs, dataset, bounds, n_shards, prefix_bytes,
+                    part_path_for) -> List[str]:
+    """Shared shard fan-out for both SAM sinks: text rendering (CPU) on
+    the write pipeline's encode workers, part writes on its I/O
+    workers (no deflate stage — SAM is plain text). Returns part paths
+    in shard order."""
+    from disq_tpu.runtime.executor import (
+        WriteShardTask,
+        run_write_stage,
+        write_retrier_for_storage,
+        writer_for_storage,
+    )
+    from disq_tpu.runtime.tracing import wrap_span
+
+    batch = dataset.reads
+
+    def make_task(k):
+        def encode():
+            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+            lines = batch_to_sam_lines(part, dataset.header)
+            return prefix_bytes + "".join(ln + "\n" for ln in lines).encode()
+
+        def stage(body):
+            p = part_path_for(k)
+            fs.write_all(p, body)
+            return p
+
+        return WriteShardTask(
+            shard_id=k,
+            encode=wrap_span("sam.write.encode", encode, shard=k),
+            stage=wrap_span("sam.write.stage", stage, shard=k),
+            retrier=write_retrier_for_storage(storage),
+            what="sam.part",
+        )
+
+    return run_write_stage(writer_for_storage(storage), n_shards, make_task)
+
+
 class SamSink:
     def __init__(self, storage=None):
         self._storage = storage
@@ -34,17 +72,18 @@ class SamSink:
         n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(temp_dir)
         try:
+            from disq_tpu.runtime.executor import write_retrier_for_storage
+
+            driver = write_retrier_for_storage(self._storage)
             header_path = os.path.join(temp_dir, "_header")
-            fs.write_all(header_path, dataset.header.text.encode())
-            part_paths: List[str] = []
-            for k in range(n_shards):
-                part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-                lines = batch_to_sam_lines(part, dataset.header)
-                body = "".join(ln + "\n" for ln in lines).encode()
-                p = os.path.join(temp_dir, f"part-{k:05d}")
-                fs.write_all(p, body)
-                part_paths.append(p)
-            fs.concat([header_path] + part_paths, path)
+            driver.call(fs.write_all, header_path,
+                        dataset.header.text.encode(), what="sam.merge")
+            part_paths = _run_sam_shards(
+                self._storage, fs, dataset, bounds, n_shards, b"",
+                lambda k: os.path.join(temp_dir, f"part-{k:05d}"),
+            )
+            driver.call(fs.concat, [header_path] + part_paths, path,
+                        what="sam.merge")
         finally:
             fs.delete(temp_dir, recursive=True)
 
@@ -58,9 +97,8 @@ class SamSinkMultiple:
         batch = dataset.reads
         n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
-        header_text = dataset.header.text
-        for k in range(n_shards):
-            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-            lines = batch_to_sam_lines(part, dataset.header)
-            data = header_text.encode() + "".join(ln + "\n" for ln in lines).encode()
-            fs.write_all(os.path.join(path, f"part-r-{k:05d}.sam"), data)
+        _run_sam_shards(
+            self._storage, fs, dataset, bounds, n_shards,
+            dataset.header.text.encode(),
+            lambda k: os.path.join(path, f"part-r-{k:05d}.sam"),
+        )

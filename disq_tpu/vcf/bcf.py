@@ -773,7 +773,12 @@ def _header_with_contig_lines(header: VcfHeader, names: Sequence[str]) -> VcfHea
 
 class BcfSink:
     """Single-file BCF write: per-shard encoded+deflated record parts
-    behind a header-block prefix, BGZF terminator appended."""
+    behind a header-block prefix, BGZF terminator appended.
+
+    Shards run through the write pipeline's encode/deflate stages
+    (overlapped across shards at ``writer_workers>1``); the single
+    output stream is written at the ordered emit, so bytes are
+    identical at any worker count."""
 
     def __init__(self, storage=None):
         self._storage = storage
@@ -782,6 +787,12 @@ class BcfSink:
         from disq_tpu.bgzf.block import BGZF_EOF_MARKER
         from disq_tpu.bgzf.codec import deflate_blob
         from disq_tpu.fsw.filesystem import resolve_path
+        from disq_tpu.runtime.executor import (
+            WriteShardTask,
+            write_retrier_for_storage,
+            writer_for_storage,
+        )
+        from disq_tpu.runtime.tracing import span, wrap_span
         from disq_tpu.util import shard_bounds
 
         fs, path = resolve_path(path)
@@ -790,13 +801,33 @@ class BcfSink:
             dataset.header, list(batch.contig_names)
         )
         n_shards, bounds = shard_bounds(self._storage, batch.count)
-        with fs.create(path) as out:
-            out.write(deflate_blob(build_bcf_header_block(header))[0])
-            for k in range(n_shards):
+
+        def make_task(k):
+            def encode():
                 part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-                body = encode_bcf_records(part, header)
-                if body:
-                    out.write(deflate_blob(body)[0])
+                return encode_bcf_records(part, header)
+
+            def deflate(body):
+                return deflate_blob(body)[0] if body else b""
+
+            return WriteShardTask(
+                shard_id=k,
+                encode=wrap_span("bcf.write.encode", encode, shard=k),
+                deflate=wrap_span("bcf.write.deflate", deflate, shard=k),
+                what="bcf.part",
+            )
+
+        pipeline = writer_for_storage(self._storage)
+        tasks = [make_task(k) for k in range(n_shards)]
+        # The stream open is the only faultable write-side call here
+        # (stream writes land in the atomic staging file directly).
+        with write_retrier_for_storage(self._storage).call(
+                fs.create, path, what="bcf.create") as out:
+            out.write(deflate_blob(build_bcf_header_block(header))[0])
+            for res in pipeline.map_ordered(tasks):
+                if res.value:
+                    with span("bcf.write.stage", shard=res.shard_id):
+                        out.write(res.value)
             out.write(BGZF_EOF_MARKER)
 
 

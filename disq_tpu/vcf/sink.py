@@ -83,51 +83,95 @@ class VcfSink:
         finally:
             fs.delete(temp_dir, recursive=True)
 
+    def _encode_shard(self, batch, bounds, k):
+        """Stage 1 (CPU): slice shard ``k`` and render its line blob."""
+        part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+        return part, _lines_blob(part)
+
+    def _deflate_shard(self, fmt, write_tbi, payload):
+        """Stage 2 (CPU): compress per the format and, for BGZF parts,
+        build the part-local tabix fragment from vectorized voffsets."""
+        part, body = payload
+        tbi_frag = None
+        if fmt is VariantsFormatWriteOption.VCF_BGZ:
+            comp, csizes = deflate_blob(body)
+            if write_tbi:
+                lens = np.diff(part.line_offsets)
+                line_starts = np.zeros(part.count + 1, dtype=np.int64)
+                np.cumsum(lens + 1, out=line_starts[1:])
+                block_comp_start = np.zeros(len(csizes) + 1, dtype=np.int64)
+                np.cumsum(csizes, out=block_comp_start[1:])
+                bidx = line_starts // BGZF_MAX_PAYLOAD
+                within = line_starts % BGZF_MAX_PAYLOAD
+                voffs = (
+                    block_comp_start[bidx].astype(np.uint64) << np.uint64(16)
+                ) | within.astype(np.uint64)
+                tbi_frag = build_tbi(
+                    part.contig_names, part.chrom, part.pos,
+                    part.end, voffs[:-1], voffs[1:],
+                )
+            data = comp
+        elif fmt is VariantsFormatWriteOption.VCF_GZ:
+            buf = io.BytesIO()
+            # mtime pinned for deterministic output
+            with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as z:
+                z.write(body)
+            data = buf.getvalue()
+        else:
+            data = body
+        return data, tbi_frag
+
+    def _stage_shard(self, fs, temp_dir, k, payload):
+        """Stage 3 (I/O): durably write the part."""
+        data, tbi_frag = payload
+        p = os.path.join(temp_dir, f"part-{k:05d}")
+        fs.write_all(p, data)
+        return {"part": p, "len": len(data), "tbi": tbi_frag}
+
     def _write_parts(
         self, fs, path, temp_dir, fmt, write_tbi, batch, header_bytes,
         n_shards, bounds,
     ) -> None:
+        from disq_tpu.runtime.executor import (
+            WriteShardTask,
+            run_write_stage,
+            write_retrier_for_storage,
+            writer_for_storage,
+        )
+        from disq_tpu.runtime.tracing import wrap_span
+
         bgz = fmt is VariantsFormatWriteOption.VCF_BGZ
         plain_gz = fmt is VariantsFormatWriteOption.VCF_GZ
-        part_paths: List[str] = []
-        part_lens: List[int] = []
-        tbi_frags: List[TbiIndex] = []
-        for k in range(n_shards):
-            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-            lens = np.diff(part.line_offsets)
-            body = _lines_blob(part)
-            if bgz:
-                comp, csizes = deflate_blob(body)
-                if write_tbi:
-                    line_starts = np.zeros(part.count + 1, dtype=np.int64)
-                    np.cumsum(lens + 1, out=line_starts[1:])
-                    block_comp_start = np.zeros(len(csizes) + 1, dtype=np.int64)
-                    np.cumsum(csizes, out=block_comp_start[1:])
-                    bidx = line_starts // BGZF_MAX_PAYLOAD
-                    within = line_starts % BGZF_MAX_PAYLOAD
-                    voffs = (
-                        block_comp_start[bidx].astype(np.uint64) << np.uint64(16)
-                    ) | within.astype(np.uint64)
-                    tbi_frags.append(
-                        build_tbi(
-                            part.contig_names, part.chrom, part.pos,
-                            part.end, voffs[:-1], voffs[1:],
-                        )
-                    )
-                data = comp
-            elif plain_gz:
-                buf = io.BytesIO()
-                # mtime pinned for deterministic output
-                with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as z:
-                    z.write(body)
-                data = buf.getvalue()
-            else:
-                data = body
-            p = os.path.join(temp_dir, f"part-{k:05d}")
-            fs.write_all(p, data)
-            part_paths.append(p)
-            part_lens.append(len(data))
 
+        def make_task(k):
+            return WriteShardTask(
+                shard_id=k,
+                encode=wrap_span(
+                    "vcf.write.encode",
+                    lambda: self._encode_shard(batch, bounds, k), shard=k),
+                deflate=wrap_span(
+                    "vcf.write.deflate",
+                    lambda p: self._deflate_shard(fmt, write_tbi, p),
+                    shard=k),
+                stage=wrap_span(
+                    "vcf.write.stage",
+                    lambda p: self._stage_shard(fs, temp_dir, k, p),
+                    shard=k),
+                retrier=write_retrier_for_storage(self._storage),
+                what="vcf.part",
+            )
+
+        infos = run_write_stage(
+            writer_for_storage(self._storage), n_shards, make_task)
+        part_paths = [i["part"] for i in infos]
+        part_lens = [i["len"] for i in infos]
+        tbi_frags: List[TbiIndex] = [
+            i["tbi"] for i in infos if i["tbi"] is not None
+        ]
+
+        # Driver-side merge writes run under the same transient retry
+        # budget as staged parts (atomic create makes retries safe).
+        driver = write_retrier_for_storage(self._storage)
         header_path = os.path.join(temp_dir, "_header")
         if bgz:
             hdr, _ = deflate_blob(header_bytes)
@@ -138,19 +182,22 @@ class VcfSink:
             hdr = buf.getvalue()
         else:
             hdr = header_bytes
-        fs.write_all(header_path, hdr)
+        driver.call(fs.write_all, header_path, hdr, what="vcf.merge")
         tail: List[str] = []
         if bgz:
             term_path = os.path.join(temp_dir, "_terminator")
-            fs.write_all(term_path, BGZF_EOF_MARKER)
+            driver.call(fs.write_all, term_path, BGZF_EOF_MARKER,
+                        what="vcf.merge")
             tail = [term_path]
-        fs.concat([header_path] + part_paths + tail, path)
+        driver.call(fs.concat, [header_path] + part_paths + tail, path,
+                    what="vcf.merge")
 
         if write_tbi and tbi_frags:
             part_starts = np.zeros(len(part_lens) + 1, dtype=np.int64)
             np.cumsum(part_lens, out=part_starts[1:])
             merged = merge_tbi_fragments(tbi_frags, list(part_starts[:-1] + len(hdr)))
-            fs.write_all(path + ".tbi", merged.to_bytes())
+            driver.call(fs.write_all, path + ".tbi", merged.to_bytes(),
+                        what="vcf.merge")
 
 
 class VcfSinkMultiple:
@@ -160,6 +207,14 @@ class VcfSinkMultiple:
         self._storage = storage
 
     def save(self, dataset, path: str, options: Sequence[WriteOption] = ()) -> None:
+        from disq_tpu.runtime.executor import (
+            WriteShardTask,
+            run_write_stage,
+            write_retrier_for_storage,
+            writer_for_storage,
+        )
+        from disq_tpu.runtime.tracing import wrap_span
+
         fs, path = resolve_path(path)
         fmt = _format_for("", options)
         ext = {"vcf": ".vcf", "vcf.gz": ".vcf.gz", "vcf.bgz": ".vcf.bgz"}[fmt.value]
@@ -167,20 +222,39 @@ class VcfSinkMultiple:
         n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         header_bytes = dataset.header.text.encode()
-        for k in range(n_shards):
-            part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
-            payload = header_bytes + _lines_blob(part)
+
+        def deflate(payload):
             if fmt is VariantsFormatWriteOption.VCF_BGZ:
                 comp, _ = deflate_blob(payload)
-                data = comp + BGZF_EOF_MARKER
-            elif fmt is VariantsFormatWriteOption.VCF_GZ:
+                return comp + BGZF_EOF_MARKER
+            if fmt is VariantsFormatWriteOption.VCF_GZ:
                 buf = io.BytesIO()
                 with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as z:
                     z.write(payload)
-                data = buf.getvalue()
-            else:
-                data = payload
-            fs.write_all(os.path.join(path, f"part-r-{k:05d}{ext}"), data)
+                return buf.getvalue()
+            return payload
+
+        def make_task(k):
+            def encode():
+                part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+                return header_bytes + _lines_blob(part)
+
+            def stage(data):
+                p = os.path.join(path, f"part-r-{k:05d}{ext}")
+                fs.write_all(p, data)
+                return p
+
+            return WriteShardTask(
+                shard_id=k,
+                encode=wrap_span("vcf.write.encode", encode, shard=k),
+                deflate=wrap_span("vcf.write.deflate", deflate, shard=k),
+                stage=wrap_span("vcf.write.stage", stage, shard=k),
+                retrier=write_retrier_for_storage(self._storage),
+                what="vcf.part",
+            )
+
+        run_write_stage(writer_for_storage(self._storage), n_shards,
+                        make_task)
 
 
 def _lines_blob(part: VariantBatch) -> bytes:

@@ -11,7 +11,11 @@ contract:
   to the fault-free baseline;
 - a bit flip under ``skip``/``quarantine`` must lose records only from
   the corrupted block, and under ``strict`` must raise
-  ``CorruptBlockError`` naming that block.
+  ``CorruptBlockError`` naming that block;
+- whatever dataset came back, writing it through the parallel write
+  pipeline (``--writer-workers``) under injected *write-side*
+  transients must produce bytes identical to a fault-free sequential
+  write of the same dataset.
 
 Usage::
 
@@ -62,6 +66,14 @@ def random_schedule(rng: random.Random):
     if rng.random() < 0.3:
         faults.append(FaultSpec(
             kind="stall", probability=0.02, stall_s=0.0))
+    # Write-side blips (op="write" never fires on reads): the staged
+    # parts' write_all/concat calls, which the writer's per-shard
+    # retrier must absorb without changing a byte.
+    faults.append(FaultSpec(
+        kind="transient", probability=rng.uniform(0.02, 0.10), op="write"))
+    if rng.random() < 0.3:
+        faults.append(FaultSpec(
+            kind="stall", probability=0.02, stall_s=0.0, op="write"))
     return faults
 
 
@@ -79,8 +91,38 @@ def pick_block(data: bytes, rng: random.Random) -> int:
     return layout[rng.randint(1, max(1, len(layout) - 2))]
 
 
+def soak_write(ds, path, it_seed: int, writer_workers: int) -> str:
+    """Write ``ds`` through the registered fault fs with the parallel
+    writer, and sequentially fault-free; the bytes must match."""
+    from disq_tpu import ReadsStorage
+
+    out_par = path + f".par-{it_seed}.bam"
+    out_seq = path + f".seq-{it_seed}.bam"
+    try:
+        from disq_tpu import DisqOptions
+
+        par_st = (ReadsStorage.make_default().num_shards(6)
+                  .options(DisqOptions(max_retries=8, retry_backoff_s=0.0))
+                  .writer_workers(writer_workers))
+        par_st.write(ds, "fault://" + out_par)
+        ReadsStorage.make_default().num_shards(6).write(ds, out_seq)
+        with open(out_par, "rb") as f:
+            par = f.read()
+        with open(out_seq, "rb") as f:
+            seq = f.read()
+        if par != seq:
+            return (f"parallel write (w={writer_workers}) differs from "
+                    f"sequential fault-free write")
+        return ""
+    finally:
+        for p in (out_par, out_seq):
+            if os.path.exists(p):
+                os.unlink(p)
+
+
 def run_iteration(path, data, n_records, baseline, it_seed: int,
-                  executor_workers: int = 1) -> str:
+                  executor_workers: int = 1,
+                  writer_workers: int = 1) -> str:
     """One soak iteration; returns "" on success, else a description."""
     import numpy as np
 
@@ -134,6 +176,9 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
 
     if policy == "strict":
         return f"strict read of corrupt block {corrupt_at} did not raise"
+    werr = soak_write(ds, path, it_seed, writer_workers)
+    if werr:
+        return f"policy={policy}: {werr}"
     if policy == "recover":
         if ds.count() != n_records:
             return (f"recover: {ds.count()} != {n_records} records "
@@ -166,6 +211,12 @@ def main(argv=None) -> int:
                          "thread-dependent, but the recovery contract — "
                          "byte identity / bounded loss / strict raise — "
                          "must hold regardless)")
+    ap.add_argument("--writer-workers", type=int, default=1,
+                    help="shard write-pipeline width for the write-back "
+                         "leg: every recovered dataset is re-written "
+                         "through the fault fs (write-side transients "
+                         "injected) and must match a fault-free "
+                         "sequential write byte for byte")
     args = ap.parse_args(argv)
 
     from disq_tpu import ReadsStorage
@@ -177,7 +228,8 @@ def main(argv=None) -> int:
         for i in range(args.iterations):
             it_seed = args.seed * 1_000_003 + i
             err = run_iteration(path, data, n_records, baseline, it_seed,
-                                executor_workers=args.executor_workers)
+                                executor_workers=args.executor_workers,
+                                writer_workers=args.writer_workers)
             status = "ok" if not err else f"FAIL: {err}"
             print(f"[{i + 1}/{args.iterations}] seed={it_seed} {status}")
             if err:

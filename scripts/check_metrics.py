@@ -38,8 +38,8 @@ CODE_ROOT = os.path.join(REPO, "disq_tpu")
 README = os.path.join(REPO, "README.md")
 
 ALLOWED_PREFIXES = {
-    "executor", "retry", "errors", "quarantine", "fsw", "codec",
-    "bam", "sam", "vcf", "bcf", "cram", "sort", "telemetry",
+    "executor", "writer", "retry", "errors", "quarantine", "fsw",
+    "codec", "bam", "sam", "vcf", "bcf", "cram", "sort", "telemetry",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

@@ -36,10 +36,20 @@ from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
 # Stage attribution: span name prefix -> waterfall glyph / category.
+# Read-direction stages first, then the write pipeline's (every format
+# sink emits <fmt>.write.encode/.deflate/.stage per shard).
 CATEGORIES = (
     ("fetch", "F", ("executor.fetch",)),
     ("decode", "D", ("executor.decode",)),
-    ("emit_stall", "s", ("executor.emit.stall",)),
+    ("encode", "E", ("bam.write.encode", "vcf.write.encode",
+                     "bcf.write.encode", "cram.write.encode",
+                     "sam.write.encode")),
+    ("deflate", "Z", ("bam.write.deflate", "vcf.write.deflate",
+                      "bcf.write.deflate")),
+    ("stage", "S", ("bam.write.stage", "vcf.write.stage",
+                    "bcf.write.stage", "cram.write.stage",
+                    "sam.write.stage")),
+    ("emit_stall", "s", ("executor.emit.stall", "writer.emit.stall")),
     ("retry", "r", ("retry.",)),
     ("quarantine", "q", ("quarantine.",)),
 )

@@ -286,7 +286,8 @@ def test_chaos_soak_smoke(executor_workers):
         "scripts", "chaos_soak.py")
     proc = subprocess.run(
         [sys.executable, script, "--iterations", "3", "--records", "200",
-         "--seed", "7", "--executor-workers", str(executor_workers)],
+         "--seed", "7", "--executor-workers", str(executor_workers),
+         "--writer-workers", str(executor_workers)],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
