@@ -501,6 +501,15 @@ def main() -> None:
     path = os.path.join(tmp, "bench.bam")
     synth_bam(path, N_RECORDS)
 
+    # BENCH_INTROSPECT=<port> serves the live endpoint for the whole
+    # bench run (port 0 = ephemeral; address on stderr so stdout stays
+    # one JSON line) — watch /progress while the configs grind.
+    if os.environ.get("BENCH_INTROSPECT"):
+        from disq_tpu import start_introspect_server
+
+        addr = start_introspect_server(int(os.environ["BENCH_INTROSPECT"]))
+        print(f"bench introspection at http://{addr}", file=sys.stderr)
+
     from disq_tpu import ReadsStorage
 
     storage = ReadsStorage.make_default().split_size(8 * 1024 * 1024)
@@ -547,7 +556,10 @@ def main() -> None:
     # (runtime/tracing.py): phase totals + p50/p99, labeled counters
     # (retries, cache hits/misses, quarantine), gauge peaks — so each
     # BENCH json carries the *why* behind its rows, not just medians.
-    from disq_tpu.runtime.tracing import telemetry_summary
+    # run_id joins this JSON against any span/progress JSONL the same
+    # process wrote (scripts/check_bench_regression.py compares the
+    # BENCH_r*.json trajectory round over round).
+    from disq_tpu.runtime.tracing import RUN_ID, telemetry_summary
 
     print(
         json.dumps(
@@ -558,6 +570,7 @@ def main() -> None:
                 "vs_baseline": round(rps / baseline_rps, 3),
                 "spread": _spread(times_fw),
                 "reps": REPS,
+                "run_id": RUN_ID,
                 "configs": configs,
                 "telemetry": telemetry_summary(),
             }

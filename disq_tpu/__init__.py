@@ -50,7 +50,11 @@ from disq_tpu.runtime import (  # noqa: F401
     QuarantineManifest,
     ShardCounters,
     StageManifest,
+    WatchdogStallError,
+    introspect_address,
     metrics_text,
+    start_introspect_server,
+    stop_introspect_server,
     phase_report,
     reduce_counters,
     span,

@@ -45,6 +45,7 @@ def _telemetry_report(counters) -> dict:
     span-log location — one dict answering "what did this read cost
     and where did the wall-clock go"."""
     from disq_tpu.runtime import tracing
+    from disq_tpu.runtime.introspect import introspect_address
 
     return {
         "run_id": tracing.RUN_ID,
@@ -53,6 +54,7 @@ def _telemetry_report(counters) -> dict:
         "phases": tracing.phase_report(),
         "gauges": tracing.gauge_report(),
         "span_log": tracing.span_log_path(),
+        "introspect": introspect_address(),
     }
 
 
@@ -167,6 +169,15 @@ class ReadsDataset:
         histograms) in one dict — see ``runtime/tracing.py``."""
         return _telemetry_report(self.counters)
 
+    def introspect_address(self) -> "str | None":
+        """``host:port`` of the live-introspection endpoint
+        (``/metrics`` / ``/healthz`` / ``/progress`` / ``/spans``)
+        serving the process this dataset was read in, or None when the
+        endpoint is disabled — see ``runtime/introspect.py``."""
+        from disq_tpu.runtime.introspect import introspect_address
+
+        return introspect_address()
+
     def coordinate_sorted(self) -> "ReadsDataset":
         from disq_tpu.sort.coordinate import coordinate_sort_batch
 
@@ -226,6 +237,12 @@ class VariantsDataset:
     def telemetry_report(self) -> dict:
         """See ``ReadsDataset.telemetry_report``."""
         return _telemetry_report(self.counters)
+
+    def introspect_address(self) -> "str | None":
+        """See ``ReadsDataset.introspect_address``."""
+        from disq_tpu.runtime.introspect import introspect_address
+
+        return introspect_address()
 
 
 def _opt(options, cls, default):
@@ -320,6 +337,39 @@ class ReadsStorage:
         self._options = replace(self._options, span_log=path)
         return self
 
+    def introspect_port(self, port: int) -> "ReadsStorage":
+        """Serve the process-wide live-introspection endpoint
+        (``/metrics`` / ``/healthz`` / ``/progress`` / ``/spans``) on
+        127.0.0.1:``port`` when a pipeline built from this storage
+        runs; ``0`` binds an ephemeral port (read it back with
+        ``dataset.introspect_address()``). Equivalent env knob:
+        ``DISQ_TPU_INTROSPECT_PORT``."""
+        from dataclasses import replace
+
+        self._options = replace(self._options, introspect_port=int(port))
+        return self
+
+    def watchdog(self, stall_s: float,
+                 policy: str = "warn") -> "ReadsStorage":
+        """Arm the heartbeat watchdog: flag any shard whose active
+        pipeline stage has been silent ``stall_s`` seconds
+        (``watchdog.stalled_shards`` / ``watchdog.stall`` telemetry,
+        ``/healthz`` degraded). ``policy="abort"`` additionally cancels
+        the run with a ``WatchdogStallError``; ``"warn"`` (default)
+        keeps going."""
+        self._options = self._options.with_watchdog(stall_s, policy)
+        return self
+
+    def progress_log(self, path: str) -> "ReadsStorage":
+        """Append a periodic JSONL progress line (shards done / in
+        flight / total, records, rolling records/sec, ETA) to ``path``
+        while pipelines run — replay with
+        ``scripts/trace_report.py --progress``."""
+        from dataclasses import replace
+
+        self._options = replace(self._options, progress_log=path)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -410,6 +460,26 @@ class VariantsStorage:
         from dataclasses import replace
 
         self._options = replace(self._options, span_log=path)
+        return self
+
+    def introspect_port(self, port: int) -> "VariantsStorage":
+        """See ``ReadsStorage.introspect_port``."""
+        from dataclasses import replace
+
+        self._options = replace(self._options, introspect_port=int(port))
+        return self
+
+    def watchdog(self, stall_s: float,
+                 policy: str = "warn") -> "VariantsStorage":
+        """See ``ReadsStorage.watchdog``."""
+        self._options = self._options.with_watchdog(stall_s, policy)
+        return self
+
+    def progress_log(self, path: str) -> "VariantsStorage":
+        """See ``ReadsStorage.progress_log``."""
+        from dataclasses import replace
+
+        self._options = replace(self._options, progress_log=path)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

@@ -162,24 +162,26 @@ class BamSource:
                 retrier=shard_ctx.retrier,
                 what=f"shard{i}",
             ))
+        from disq_tpu.runtime.introspect import note_shard_counters
+
         out = []
         self._last_counters = []
         for res in executor_for_storage(self._storage).map_ordered(tasks):
             batch, stats = res.value
             shard_ctx = shard_ctxs[res.shard_id]
-            self._last_counters.append(
-                ShardCounters(
-                    shard_id=res.shard_id,
-                    records=batch.count,
-                    blocks=stats[0],
-                    bytes_compressed=stats[1],
-                    bytes_uncompressed=stats[2],
-                    wall_seconds=res.wall_seconds,
-                    skipped_blocks=shard_ctx.skipped_blocks,
-                    quarantined_blocks=shard_ctx.quarantined_blocks,
-                    retried_reads=shard_ctx.retrier.retried,
-                )
+            c = ShardCounters(
+                shard_id=res.shard_id,
+                records=batch.count,
+                blocks=stats[0],
+                bytes_compressed=stats[1],
+                bytes_uncompressed=stats[2],
+                wall_seconds=res.wall_seconds,
+                skipped_blocks=shard_ctx.skipped_blocks,
+                quarantined_blocks=shard_ctx.quarantined_blocks,
+                retried_reads=shard_ctx.retrier.retried,
             )
+            self._last_counters.append(c)
+            note_shard_counters("read", c)  # live /progress feed
             out.append(batch)
         return out
 

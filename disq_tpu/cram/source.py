@@ -136,6 +136,8 @@ class CramSource:
                 retrier=shard_ctx.retrier,
                 what=f"cram-shard{i}",
             ))
+        from disq_tpu.runtime.introspect import note_shard_counters
+
         batches = []
         shard_counters = []
         for res in executor_for_storage(self._storage).map_ordered(tasks):
@@ -143,18 +145,18 @@ class CramSource:
             shard_ctx = shard_ctxs[res.shard_id]
             owned = owned_by_shard[res.shard_id]
             batches.extend(shard_batches)
-            shard_counters.append(
-                ShardCounters(
-                    shard_id=res.shard_id,
-                    records=sum(b.count for b in shard_batches),
-                    blocks=len(owned),
-                    bytes_compressed=sum(h.length for _, h in owned),
-                    wall_seconds=res.wall_seconds,
-                    skipped_blocks=shard_ctx.skipped_blocks,
-                    quarantined_blocks=shard_ctx.quarantined_blocks,
-                    retried_reads=shard_ctx.retrier.retried,
-                )
+            c = ShardCounters(
+                shard_id=res.shard_id,
+                records=sum(b.count for b in shard_batches),
+                blocks=len(owned),
+                bytes_compressed=sum(h.length for _, h in owned),
+                wall_seconds=res.wall_seconds,
+                skipped_blocks=shard_ctx.skipped_blocks,
+                quarantined_blocks=shard_ctx.quarantined_blocks,
+                retried_reads=shard_ctx.retrier.retried,
             )
+            shard_counters.append(c)
+            note_shard_counters("read", c)  # live /progress feed
         counters = reduce_counters(shard_counters)
         # Walk/header-phase events happened on the top-level context,
         # outside any shard's counters.

@@ -28,6 +28,14 @@ protocol. JAX has no task retry, so the equivalents here are:
   ring + JSONL sink (``DISQ_TPU_TRACE_JSONL``, Chrome/Perfetto
   export), and the ``jax.profiler`` bridge (``trace_phase``,
   ``DISQ_TPU_TRACE_DIR``).
+- ``introspect`` — the live half of observability: an opt-in
+  in-process HTTP endpoint (``/metrics`` / ``/healthz`` /
+  ``/progress`` / ``/spans``; ``DisqOptions.introspect_port`` /
+  ``DISQ_TPU_INTROSPECT_PORT``), a heartbeat watchdog flagging shards
+  whose active pipeline stage went silent past
+  ``DisqOptions.watchdog_stall_s`` (policy ``warn`` | ``abort``), and
+  a progress/ETA reporter with an optional periodic JSONL log
+  (``DisqOptions.progress_log``).
 - ``debug`` — a debug mode (``DISQ_TPU_DEBUG=1``) asserting
   shard-boundary invariants (record counts, offset monotonicity)
   after each phase.
@@ -46,6 +54,7 @@ from disq_tpu.runtime.errors import (  # noqa: F401
     ShardRetrier,
     TransientIOError,
     TruncatedReadError,
+    WatchdogStallError,
     context_for_storage,
     is_transient,
 )
@@ -62,6 +71,16 @@ from disq_tpu.runtime.executor import (  # noqa: F401
     run_write_stage,
     write_retrier_for_storage,
     writer_for_storage,
+)
+from disq_tpu.runtime.introspect import (  # noqa: F401
+    HEALTH,
+    PipelineHealth,
+    introspect_address,
+    note_shard_counters,
+    start_introspect_server,
+    start_progress_log,
+    stop_introspect_server,
+    stop_progress_log,
 )
 from disq_tpu.runtime.manifest import (  # noqa: F401
     QuarantineManifest,

@@ -91,6 +91,25 @@ class DisqOptions:
     and the sink keeps collecting until ``stop_span_log()`` (each
     run's spans carry its ``run_id``, so appended runs stay
     separable).
+
+    Live introspection (``runtime/introspect.py``):
+
+    - ``introspect_port`` starts the process-wide 127.0.0.1 HTTP
+      endpoint (``/metrics`` / ``/healthz`` / ``/progress`` /
+      ``/spans``) the first time a pipeline built from these options
+      runs; 0 binds an ephemeral port (also: env
+      ``DISQ_TPU_INTROSPECT_PORT``). None (the default) never creates
+      a thread or socket.
+    - ``watchdog_stall_s`` arms the heartbeat watchdog: any shard
+      whose active pipeline stage has been silent that many seconds is
+      flagged (``watchdog.stalled_shards`` counter, ``watchdog.stall``
+      span, one rate-limited stderr line, ``/healthz`` degraded).
+      ``watchdog_policy`` decides what happens next: ``"warn"`` (the
+      default) keeps running; ``"abort"`` cancels the run through the
+      pipeline's first-error-abort path with a ``WatchdogStallError``.
+    - ``progress_log`` appends a periodic JSONL progress line
+      (shards done / in flight / total, records, rolling records/sec,
+      ETA) that ``scripts/trace_report.py --progress`` replays.
     """
 
     error_policy: ErrorPolicy = ErrorPolicy.STRICT
@@ -102,6 +121,10 @@ class DisqOptions:
     writer_workers: int = 1
     writer_prefetch_shards: Optional[int] = None
     span_log: Optional[str] = None
+    introspect_port: Optional[int] = None
+    watchdog_stall_s: Optional[float] = None
+    watchdog_policy: str = "warn"
+    progress_log: Optional[str] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -119,6 +142,17 @@ class DisqOptions:
             raise ValueError(f"writer_workers must be >= 1, got {workers}")
         return replace(self, writer_workers=int(workers),
                        writer_prefetch_shards=prefetch_shards)
+
+    def with_watchdog(self, stall_s: float,
+                      policy: str = "warn") -> "DisqOptions":
+        if stall_s <= 0:
+            raise ValueError(
+                f"watchdog_stall_s must be > 0, got {stall_s}")
+        if policy not in ("warn", "abort"):
+            raise ValueError(
+                f"watchdog_policy must be 'warn' or 'abort', got {policy!r}")
+        return replace(self, watchdog_stall_s=float(stall_s),
+                       watchdog_policy=policy)
 
 
 class CorruptBlockError(ValueError):
@@ -161,6 +195,27 @@ class MissingReferenceError(ValueError):
     catastrophe, not fault tolerance)."""
 
 
+class WatchdogStallError(RuntimeError):
+    """The heartbeat watchdog (``runtime/introspect.py``) flagged a
+    shard as stalled past ``DisqOptions.watchdog_stall_s`` under
+    ``watchdog_policy="abort"``: the pipeline run is cancelled through
+    its first-error-abort path. Deliberately NOT transient — retrying
+    the very work the watchdog just declared wedged would mask the
+    hang it exists to surface."""
+
+    def __init__(self, message: str, *, shard_id: int = -1,
+                 stage: str = "", age_s: float = 0.0,
+                 direction: str = "") -> None:
+        detail = (f"{message} [direction={direction or '?'} "
+                  f"shard={shard_id} stage={stage or '?'} "
+                  f"silent_for={age_s:.3f}s]")
+        super().__init__(detail)
+        self.shard_id = shard_id
+        self.stage = stage
+        self.age_s = age_s
+        self.direction = direction
+
+
 class TruncatedReadError(OSError, ValueError):
     """A range read returned fewer bytes than the on-disk structure
     requires. Subclasses ``OSError`` (it is an I/O symptom — a flaky
@@ -182,7 +237,7 @@ def is_transient(exc: BaseException) -> bool:
     """Transient (retryable) vs. permanent/corrupt classification."""
     if isinstance(exc, TransientIOError):
         return True
-    if isinstance(exc, CorruptBlockError):
+    if isinstance(exc, (CorruptBlockError, WatchdogStallError)):
         return False
     if isinstance(exc, _PERMANENT_OS_ERRORS):
         return False

@@ -139,6 +139,15 @@ class _BoundedStagePipeline:
     stay literal at their call sites (the metric-name lint scans
     literals). ``on_result`` and ``on_stall`` run with the pipeline
     condition held — keep them cheap and non-blocking.
+
+    When a run is live-introspected (``health`` is a
+    ``PipelineHealth`` board and ``health_token`` its run token), every
+    stage worker stamps a per-shard heartbeat as it starts a stage and
+    clears it when the stage returns, and the watchdog can cancel the
+    run through the existing first-error-abort path: the injected
+    ``WatchdogStallError`` is recorded at the emit frontier, so the
+    consumer raises it deterministically at its next ``next()``. With
+    ``health=None`` (the default) none of this code runs.
     """
 
     def __init__(
@@ -151,6 +160,9 @@ class _BoundedStagePipeline:
         on_result: Callable[[List[float]], None],
         on_stall: Callable[[float, Any], None],
         drain_on_close: bool = False,
+        stage_names: Sequence[str] = (),
+        health=None,
+        health_token: Optional[int] = None,
     ) -> None:
         self.workers = workers
         self.window = window
@@ -164,6 +176,9 @@ class _BoundedStagePipeline:
         # own temp-dir cleanup; the read direction keeps wait=False (a
         # stalled remote fetch must not block the caller's error).
         self.drain_on_close = drain_on_close
+        self.stage_names = list(stage_names)
+        self.health = health
+        self.health_token = health_token
 
     def run(self, tasks: List[Any]) -> Iterator[tuple]:
         """Admit the first window EAGERLY (stage-0 work is in flight
@@ -182,6 +197,24 @@ class _BoundedStagePipeline:
             for prefix in self.thread_prefixes
         ]
 
+        health, token = self.health, self.health_token
+        if health is not None and token is not None:
+            # The watchdog's abort path: record the stall error at the
+            # emit frontier so the consumer's next ``next()`` raises it
+            # (same mechanics as a stage failure on that shard).
+            def inject_abort(exc: BaseException) -> None:
+                with cond:
+                    if state["aborted"]:
+                        return
+                    errors.setdefault(state["next_emit"], exc)
+                    cond.notify_all()
+
+            health.set_abort(token, inject_abort)
+
+        def stage_name(stage: int) -> str:
+            return (self.stage_names[stage]
+                    if stage < len(self.stage_names) else str(stage))
+
         def record_error(idx: int, exc: BaseException) -> None:
             with cond:
                 errors[idx] = exc
@@ -196,12 +229,19 @@ class _BoundedStagePipeline:
                         state["in_flight"] -= 1
                         cond.notify_all()
                         return
+            shard = getattr(task, "shard_id", idx)
+            if health is not None:
+                health.beat(token, stage_name(stage), shard)
             t0 = time.perf_counter()
             try:
                 value = self.stage_fns[stage](task, payload)
             except BaseException as e:  # noqa: BLE001 — re-raised at emit
+                if health is not None:
+                    health.clear(token, stage_name(stage), shard)
                 record_error(idx, e)
                 return
+            if health is not None:
+                health.clear(token, stage_name(stage), shard)
             seconds.append(time.perf_counter() - t0)
             if stage + 1 < n_stages:
                 pools[stage + 1].submit(job, stage + 1, idx, task, value,
@@ -253,6 +293,29 @@ class _BoundedStagePipeline:
         return emit()
 
 
+def _check_abort(health, token: Optional[int]) -> None:
+    """Cooperative watchdog-abort pickup for the inline (workers=1)
+    paths, which have no pipeline to inject an error into: raise the
+    parked WatchdogStallError at the stage boundary where the run's
+    own thread next surfaces."""
+    if health is not None and token is not None:
+        exc = health.take_abort(token)
+        if exc is not None:
+            raise exc
+
+
+def _tracked(inner: Iterator, health, token: int) -> Iterator:
+    """Wrap an ordered-emit iterator so each yielded shard is marked
+    done on the health board and the run is closed out when the
+    iterator ends (normally, by error, or abandoned)."""
+    try:
+        for res in inner:
+            health.shard_done(token, res.shard_id)
+            yield res
+    finally:
+        health.finish_run(token)
+
+
 class ShardPipelineExecutor:
     """Bounded three-stage shard pipeline (see module docstring).
 
@@ -264,7 +327,10 @@ class ShardPipelineExecutor:
     """
 
     def __init__(self, workers: int = 1,
-                 prefetch_shards: Optional[int] = None) -> None:
+                 prefetch_shards: Optional[int] = None,
+                 health=None,
+                 watchdog_stall_s: Optional[float] = None,
+                 watchdog_policy: str = "warn") -> None:
         self.workers = max(1, int(workers))
         if prefetch_shards is None:
             prefetch_shards = 2 * self.workers
@@ -276,6 +342,12 @@ class ShardPipelineExecutor:
             workers=self.workers,
             window=self.prefetch_shards,
         )
+        # Live introspection (None = disabled, the zero-overhead path):
+        # a PipelineHealth board receiving run registration, per-shard
+        # heartbeats and completions — see runtime/introspect.py.
+        self._health = health
+        self._watchdog_stall_s = watchdog_stall_s
+        self._watchdog_policy = watchdog_policy
 
     # -- public -------------------------------------------------------------
 
@@ -288,31 +360,53 @@ class ShardPipelineExecutor:
         self.stats.shards += len(tasks)
         if not tasks:
             return iter(())
+        token = None
+        if self._health is not None:
+            token = self._health.register_run(
+                "read", len(tasks), self._watchdog_stall_s,
+                self._watchdog_policy)
         if self.workers == 1:
-            return self._run_sequential(tasks)
-        return self._run_pipelined(tasks)
+            inner = self._run_sequential(tasks, token)
+        else:
+            inner = self._run_pipelined(tasks, token)
+        if token is None:
+            return inner
+        return _tracked(inner, self._health, token)
 
     # -- sequential (workers=1): the exact pre-executor call order ----------
 
-    def _run_sequential(self, tasks: List[ShardTask]) -> Iterator[ShardResult]:
+    def _run_sequential(self, tasks: List[ShardTask],
+                        token: Optional[int] = None
+                        ) -> Iterator[ShardResult]:
         for task in tasks:
-            yield self._run_one_inline(task)
+            yield self._run_one_inline(task, token)
 
-    def _run_one_inline(self, task: ShardTask) -> ShardResult:
+    def _run_one_inline(self, task: ShardTask,
+                        token: Optional[int] = None) -> ShardResult:
         """Whole-shard work under ONE retrier budget — identical
         semantics (and retry accounting) to the historical
         ``retrier.call(decode_range, …)`` per-shard loop."""
         times = [0.0, 0.0]
+        health = self._health if token is not None else None
 
         def attempt():
             t0 = time.perf_counter()
+            _check_abort(health, token)
+            if health is not None:
+                health.beat(token, "fetch", task.shard_id)
             with span("executor.fetch", shard=task.shard_id):
                 payload = task.fetch()
             t1 = time.perf_counter()
             times[0] += t1 - t0
+            _check_abort(health, token)
+            if health is not None:
+                health.beat(token, "decode", task.shard_id)
             with span("executor.decode", shard=task.shard_id):
                 value = task.decode(payload)
             times[1] += time.perf_counter() - t1
+            if health is not None:
+                health.clear(token, "decode", task.shard_id)
+            _check_abort(health, token)
             return value
 
         if task.retrier is not None:
@@ -325,7 +419,9 @@ class ShardPipelineExecutor:
 
     # -- pipelined (workers>1) ----------------------------------------------
 
-    def _run_pipelined(self, tasks: List[ShardTask]) -> Iterator[ShardResult]:
+    def _run_pipelined(self, tasks: List[ShardTask],
+                       token: Optional[int] = None
+                       ) -> Iterator[ShardResult]:
         """Two stages over the shared bounded core: fetch (with the
         per-shard retrier) and decode (with the transient-escape
         refetch hatch)."""
@@ -365,6 +461,9 @@ class ShardPipelineExecutor:
             on_admit=on_admit,
             on_result=on_result,
             on_stall=on_stall,
+            stage_names=("fetch", "decode"),
+            health=self._health if token is not None else None,
+            health_token=token,
         )
         inner = core.run(tasks)  # admits the first window eagerly
 
@@ -397,11 +496,20 @@ class ShardPipelineExecutor:
 
 def executor_for_storage(storage) -> ShardPipelineExecutor:
     """Build the shard executor from a storage builder's
-    ``DisqOptions`` (absent/None ⇒ sequential-compatible defaults)."""
+    ``DisqOptions`` (absent/None ⇒ sequential-compatible defaults).
+    This is also where live introspection turns on for a read: the
+    options' endpoint / watchdog / progress-log knobs are resolved
+    once per run, and the default (nothing configured) hands the
+    executor ``health=None`` — the no-op path."""
+    from disq_tpu.runtime.introspect import configure_from_options
+
     opts = getattr(storage, "_options", None) or DisqOptions()
     return ShardPipelineExecutor(
         workers=getattr(opts, "executor_workers", 1),
         prefetch_shards=getattr(opts, "prefetch_shards", None),
+        health=configure_from_options(opts),
+        watchdog_stall_s=getattr(opts, "watchdog_stall_s", None),
+        watchdog_policy=getattr(opts, "watchdog_policy", "warn"),
     )
 
 
@@ -487,7 +595,10 @@ class ShardWritePipeline:
     (uncompressed + compressed shard bytes)``."""
 
     def __init__(self, workers: int = 1,
-                 prefetch_shards: Optional[int] = None) -> None:
+                 prefetch_shards: Optional[int] = None,
+                 health=None,
+                 watchdog_stall_s: Optional[float] = None,
+                 watchdog_policy: str = "warn") -> None:
         self.workers = max(1, int(workers))
         if prefetch_shards is None:
             prefetch_shards = 2 * self.workers
@@ -499,6 +610,10 @@ class ShardWritePipeline:
             workers=self.workers,
             window=self.prefetch_shards,
         )
+        # Live introspection (see ShardPipelineExecutor / introspect.py).
+        self._health = health
+        self._watchdog_stall_s = watchdog_stall_s
+        self._watchdog_policy = watchdog_policy
 
     # -- public -------------------------------------------------------------
 
@@ -509,9 +624,18 @@ class ShardWritePipeline:
         self.stats.shards += len(tasks)
         if not tasks:
             return iter(())
+        token = None
+        if self._health is not None:
+            token = self._health.register_run(
+                "write", len(tasks), self._watchdog_stall_s,
+                self._watchdog_policy)
         if self.workers == 1:
-            return self._run_sequential(tasks)
-        return self._run_pipelined(tasks)
+            inner = self._run_sequential(tasks, token)
+        else:
+            inner = self._run_pipelined(tasks, token)
+        if token is None:
+            return inner
+        return _tracked(inner, self._health, token)
 
     # -- stage bodies (shared by both paths) --------------------------------
 
@@ -537,15 +661,24 @@ class ShardWritePipeline:
     # -- sequential (workers=1): the historical per-shard loop order --------
 
     def _run_sequential(
-        self, tasks: List[WriteShardTask]
+        self, tasks: List[WriteShardTask],
+        token: Optional[int] = None,
     ) -> Iterator[WriteShardResult]:
+        health = self._health if token is not None else None
         for task in tasks:
             secs = []
             payload = None
-            for fn in (self._encode, self._deflate, self._stage):
+            for name, fn in (("encode", self._encode),
+                             ("deflate", self._deflate),
+                             ("stage", self._stage)):
+                _check_abort(health, token)
+                if health is not None:
+                    health.beat(token, name, task.shard_id)
                 t0 = time.perf_counter()
                 payload = fn(task, payload)
                 secs.append(time.perf_counter() - t0)
+                if health is not None:
+                    health.clear(token, name, task.shard_id)
             self.stats.encode_seconds += secs[0]
             self.stats.deflate_seconds += secs[1]
             self.stats.stage_seconds += secs[2]
@@ -554,7 +687,8 @@ class ShardWritePipeline:
     # -- pipelined (workers>1) ----------------------------------------------
 
     def _run_pipelined(
-        self, tasks: List[WriteShardTask]
+        self, tasks: List[WriteShardTask],
+        token: Optional[int] = None,
     ) -> Iterator[WriteShardResult]:
         def on_admit(depth: int) -> None:
             if depth > self.stats.max_in_flight:
@@ -592,6 +726,10 @@ class ShardWritePipeline:
             on_result=on_result,
             on_stall=on_stall,
             drain_on_close=True,
+            # "encode_seconds" -> heartbeat stage name "encode", etc.
+            stage_names=[a.split("_", 1)[0] for a in attr_names],
+            health=self._health if token is not None else None,
+            health_token=token,
         )
         inner = core.run(tasks)  # admits the first window eagerly
 
@@ -610,11 +748,18 @@ class ShardWritePipeline:
 
 def writer_for_storage(storage) -> ShardWritePipeline:
     """Build the write pipeline from a storage builder's
-    ``DisqOptions`` (absent/None ⇒ sequential-compatible defaults)."""
+    ``DisqOptions`` (absent/None ⇒ sequential-compatible defaults).
+    Live-introspection knobs resolve here for writes, mirroring
+    ``executor_for_storage`` for reads."""
+    from disq_tpu.runtime.introspect import configure_from_options
+
     opts = getattr(storage, "_options", None) or DisqOptions()
     return ShardWritePipeline(
         workers=getattr(opts, "writer_workers", 1),
         prefetch_shards=getattr(opts, "writer_prefetch_shards", None),
+        health=configure_from_options(opts),
+        watchdog_stall_s=getattr(opts, "watchdog_stall_s", None),
+        watchdog_policy=getattr(opts, "watchdog_policy", "warn"),
     )
 
 

@@ -34,6 +34,8 @@ class StageManifest:
     """
 
     def __init__(self, path: str, params: Optional[Dict[str, Any]] = None):
+        from disq_tpu.runtime.tracing import RUN_ID
+
         self.path = path
         # The parallel write pipeline records shard completion from its
         # stage workers as each shard's part lands — mark_done (ledger
@@ -43,6 +45,11 @@ class StageManifest:
             "version": FORMAT_VERSION,
             "params": params or {},
             "stages": {},
+            # Telemetry join key: the run that created this manifest.
+            # Per-shard completions additionally record the run that
+            # marked them (a resumed manifest mixes runs), so the
+            # resume ledger joins span/progress JSONL on run_id.
+            "run_id": RUN_ID,
         }
         if os.path.exists(path):
             try:
@@ -90,9 +97,22 @@ class StageManifest:
             return self._stage(stage)["shards"][str(shard_id)]
 
     def mark_done(self, stage: str, shard_id: int, info: Any = None) -> None:
+        from disq_tpu.runtime.tracing import RUN_ID
+
         with self._lock:
-            self._stage(stage)["shards"][str(shard_id)] = info
+            st = self._stage(stage)
+            st["shards"][str(shard_id)] = info
+            # Which run completed this shard (keyed beside "shards" so
+            # shard_info() keeps returning the caller's payload
+            # verbatim; resumed manifests mix run ids here).
+            st.setdefault("runs", {})[str(shard_id)] = RUN_ID
             self._flush()
+
+    def shard_run_id(self, stage: str, shard_id: int) -> Optional[str]:
+        """The ``run_id`` that marked this shard done (None for shards
+        recorded by a pre-run_id manifest)."""
+        with self._lock:
+            return self._stage(stage).get("runs", {}).get(str(shard_id))
 
     def completed_shards(self, stage: str) -> List[int]:
         with self._lock:
@@ -156,7 +176,9 @@ class QuarantineManifest:
     - ``MANIFEST.jsonl`` — line 1 is ``{"version": 1}``; every further
       line is one quarantined-block record ``{"path", "shard_id",
       "block_offset", "virtual_offset", "kind", "error", "sidecar",
-      "length"}``, appended as the block is set aside. Append-only
+      "length", "run_id"}``, appended as the block is set aside
+      (``run_id`` is the process-wide telemetry run id, so the ledger
+      joins span/progress JSONL from the same run). Append-only
       keeps the ledger O(1) per corrupt block — quarantine exists
       precisely for heavily damaged inputs, where rewriting a JSON
       document per block would be quadratic. A crash can tear at most
@@ -233,7 +255,7 @@ class QuarantineManifest:
         """Copy one corrupt block aside; returns the sidecar path.
         Timed as a ``quarantine.write`` span so a slow quarantine disk
         shows up on the shard timeline, not just as mystery stall."""
-        from disq_tpu.runtime.tracing import span
+        from disq_tpu.runtime.tracing import RUN_ID, span
 
         with span("quarantine.write", shard=shard_id,
                   block_offset=block_offset, kind=kind):
@@ -262,6 +284,10 @@ class QuarantineManifest:
                 "error": error,
                 "sidecar": sidecar,
                 "length": len(raw),
+                # Telemetry join key: correlate this ledger line with
+                # the span/progress JSONL of the run that set the
+                # block aside.
+                "run_id": RUN_ID,
             }
             self._entries[(path, block_offset)] = entry
             self._append(entry)

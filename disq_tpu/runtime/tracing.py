@@ -490,6 +490,9 @@ _span_ring: "deque[Dict[str, Any]]" = deque(maxlen=DEFAULT_SPAN_RING)
 _span_sink = None            # open file object, or None
 _span_sink_path: Optional[str] = None
 _span_writes = 0             # lines since the last explicit flush
+_sink_dropped_base = 0.0     # telemetry.dropped_spans total when this
+                             # sink opened — the stop trailer reports
+                             # only drops during the sink's lifetime
 _SINK_FLUSH_EVERY = 64       # amortize flushes: a synchronous flush per
                              # span would serialize every worker thread
                              # on trace-disk latency (close() flushes
@@ -516,13 +519,15 @@ def start_span_log(path: str) -> None:
     appended as one JSON line; a meta line maps this run's monotonic
     clock to the epoch so timelines from multiple runs stay
     separable."""
-    global _span_sink, _span_sink_path, _env_resolved
+    global _span_sink, _span_sink_path, _env_resolved, _sink_dropped_base
+    dropped_now = REGISTRY.counter("telemetry.dropped_spans").total()
     with _span_lock:
         _env_resolved = True  # explicit call wins over the env knob
         if _span_sink is not None:
             if _span_sink_path == path:
                 return
             _span_sink.close()
+        _sink_dropped_base = dropped_now
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -538,8 +543,21 @@ def start_span_log(path: str) -> None:
 
 def stop_span_log() -> None:
     global _span_sink, _span_sink_path, _span_writes
+    total = REGISTRY.counter("telemetry.dropped_spans").total()
     with _span_lock:
         if _span_sink is not None:
+            dropped = int(total - _sink_dropped_base)
+            if dropped > 0:
+                # Trailer meta line: the in-memory ring overflowed
+                # during this sink's lifetime, so any ring-derived view
+                # (/spans, chrome export) is truncated even though the
+                # JSONL itself is complete — trace_report surfaces it
+                # as a banner instead of silently rendering a partial
+                # waterfall.
+                _span_sink.write(json.dumps({
+                    "meta": 1, "run_id": RUN_ID,
+                    "dropped_spans": dropped,
+                }) + "\n")
             _span_sink.close()  # flushes any buffered tail
             _span_sink = None
             _span_sink_path = None

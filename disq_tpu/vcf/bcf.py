@@ -696,19 +696,23 @@ class BcfSource:
                 retrier=shard_ctx.retrier,
                 what=f"bcf-split{i}",
             ))
+        from disq_tpu.runtime.introspect import note_shard_counters
+
         parts = []
         shard_counters = []
         for res in executor_for_storage(self._storage).map_ordered(tasks):
             part, n_blocks, c_bytes = res.value
             parts.append(part)
-            shard_counters.append(ShardCounters(
+            c = ShardCounters(
                 shard_id=res.shard_id,
                 blocks=n_blocks,
                 bytes_compressed=c_bytes,
                 bytes_uncompressed=len(part),
                 wall_seconds=res.wall_seconds,
                 retried_reads=shard_ctxs[res.shard_id].retrier.retried,
-            ))
+            )
+            shard_counters.append(c)
+            note_shard_counters("read", c)  # live /progress feed
         payload = b"".join(parts)
         header, rec_off = read_bcf_header_block(payload)
         batch = decode_bcf_records(payload, header, rec_off)

@@ -124,18 +124,19 @@ class VcfSource:
 
     def _track(self, shard_ctx, shard_id: int, batch) -> None:
         from disq_tpu.runtime import ShardCounters
+        from disq_tpu.runtime.introspect import note_shard_counters
 
         if shard_ctx is None:
             return
-        self._last_counters.append(
-            ShardCounters(
-                shard_id=shard_id,
-                records=int(batch.count),
-                skipped_blocks=shard_ctx.skipped_blocks,
-                quarantined_blocks=shard_ctx.quarantined_blocks,
-                retried_reads=shard_ctx.retrier.retried,
-            )
+        c = ShardCounters(
+            shard_id=shard_id,
+            records=int(batch.count),
+            skipped_blocks=shard_ctx.skipped_blocks,
+            quarantined_blocks=shard_ctx.quarantined_blocks,
+            retried_reads=shard_ctx.retrier.retried,
         )
+        self._last_counters.append(c)
+        note_shard_counters("read", c)  # live /progress feed
 
     def _read_whole_gzip(self, fs, path, header) -> VariantBatch:
         # Plain gzip is not splittable: one task reads the whole file

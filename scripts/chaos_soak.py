@@ -53,7 +53,7 @@ def build_fixture(tmp_dir: str, n_records: int, seed: int):
     return path, data, len(records)
 
 
-def random_schedule(rng: random.Random):
+def random_schedule(rng: random.Random, watchdog: bool = False):
     from disq_tpu.fsw import FaultSpec
 
     faults = [
@@ -74,6 +74,15 @@ def random_schedule(rng: random.Random):
     if rng.random() < 0.3:
         faults.append(FaultSpec(
             kind="stall", probability=0.02, stall_s=0.0, op="write"))
+    if watchdog:
+        # --watchdog leg: one REAL stall on the first write-side call —
+        # that call is always a part staged from a heartbeating stage
+        # worker (the driver-side merge runs after the parts), so the
+        # watchdog must flag it within its window. Deterministic:
+        # probability 1.0, once.
+        faults.append(FaultSpec(
+            kind="stall", probability=1.0, stall_s=0.3, times=1,
+            op="write"))
     return faults
 
 
@@ -91,9 +100,14 @@ def pick_block(data: bytes, rng: random.Random) -> int:
     return layout[rng.randint(1, max(1, len(layout) - 2))]
 
 
-def soak_write(ds, path, it_seed: int, writer_workers: int) -> str:
+def soak_write(ds, path, it_seed: int, writer_workers: int,
+               watchdog: bool = False) -> str:
     """Write ``ds`` through the registered fault fs with the parallel
-    writer, and sequentially fault-free; the bytes must match."""
+    writer, and sequentially fault-free; the bytes must match. With
+    ``watchdog``, the schedule carries a guaranteed write-side stall
+    (see ``random_schedule``) and the leg additionally asserts the
+    heartbeat watchdog flagged it — detection is part of the recovery
+    contract, not a side effect."""
     from disq_tpu import ReadsStorage
 
     out_par = path + f".par-{it_seed}.bam"
@@ -101,10 +115,23 @@ def soak_write(ds, path, it_seed: int, writer_workers: int) -> str:
     try:
         from disq_tpu import DisqOptions
 
+        opts = DisqOptions(max_retries=8, retry_backoff_s=0.0)
+        if watchdog:
+            opts = opts.with_watchdog(0.08, "warn")
+            writer_workers = max(2, writer_workers)
         par_st = (ReadsStorage.make_default().num_shards(6)
-                  .options(DisqOptions(max_retries=8, retry_backoff_s=0.0))
+                  .options(opts)
                   .writer_workers(writer_workers))
+        if watchdog:
+            from disq_tpu.runtime.tracing import counter
+
+            stalled_before = counter("watchdog.stalled_shards").total()
         par_st.write(ds, "fault://" + out_par)
+        if watchdog:
+            stalled_after = counter("watchdog.stalled_shards").total()
+            if stalled_after <= stalled_before:
+                return ("watchdog missed the injected write-side stall "
+                        f"(counter {stalled_before} -> {stalled_after})")
         ReadsStorage.make_default().num_shards(6).write(ds, out_seq)
         with open(out_par, "rb") as f:
             par = f.read()
@@ -122,7 +149,8 @@ def soak_write(ds, path, it_seed: int, writer_workers: int) -> str:
 
 def run_iteration(path, data, n_records, baseline, it_seed: int,
                   executor_workers: int = 1,
-                  writer_workers: int = 1) -> str:
+                  writer_workers: int = 1,
+                  watchdog: bool = False) -> str:
     """One soak iteration; returns "" on success, else a description."""
     import numpy as np
 
@@ -140,7 +168,7 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
     )
 
     rng = random.Random(it_seed)
-    faults = random_schedule(rng)
+    faults = random_schedule(rng, watchdog=watchdog)
     policy = rng.choice(["strict", "skip", "quarantine", "recover"])
     corrupt_at = None
     if policy != "recover":
@@ -162,6 +190,11 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
         quarantine_dir=path + f".quarantine-{it_seed}",
         executor_workers=executor_workers,
     )
+    if watchdog:
+        # Arm the read-side watchdog too (warn): the randomized read
+        # stalls are zero-length so nothing should be flagged, but
+        # every heartbeat path runs under chaos.
+        opts = opts.with_watchdog(0.25, "warn")
     storage = ReadsStorage.make_default().split_size(SPLIT).options(opts)
 
     try:
@@ -176,7 +209,8 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
 
     if policy == "strict":
         return f"strict read of corrupt block {corrupt_at} did not raise"
-    werr = soak_write(ds, path, it_seed, writer_workers)
+    werr = soak_write(ds, path, it_seed, writer_workers,
+                      watchdog=watchdog)
     if werr:
         return f"policy={policy}: {werr}"
     if policy == "recover":
@@ -217,6 +251,13 @@ def main(argv=None) -> int:
                          "through the fault fs (write-side transients "
                          "injected) and must match a fault-free "
                          "sequential write byte for byte")
+    ap.add_argument("--watchdog", action="store_true",
+                    help="arm the heartbeat watchdog on both directions "
+                         "and inject one guaranteed write-side stall per "
+                         "write-back leg: the iteration FAILS unless "
+                         "watchdog.stalled_shards flags it within the "
+                         "window (stall-kind legs assert detection, not "
+                         "just recovery)")
     args = ap.parse_args(argv)
 
     from disq_tpu import ReadsStorage
@@ -229,7 +270,8 @@ def main(argv=None) -> int:
             it_seed = args.seed * 1_000_003 + i
             err = run_iteration(path, data, n_records, baseline, it_seed,
                                 executor_workers=args.executor_workers,
-                                writer_workers=args.writer_workers)
+                                writer_workers=args.writer_workers,
+                                watchdog=args.watchdog)
             status = "ok" if not err else f"FAIL: {err}"
             print(f"[{i + 1}/{args.iterations}] seed={it_seed} {status}")
             if err:

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""check_bench_regression — guard the BENCH_r*.json trajectory.
+
+Every round the harness appends a ``BENCH_rNN.json``; nothing so far
+*compared* them, so a throughput regression only surfaced if a human
+happened to read two JSONs side by side. This script makes the
+trajectory a gate:
+
+- It collects every throughput series from the per-config results
+  (any ``records_per_sec`` / ``mb_per_sec`` / ``staged_records_per_sec``
+  leaf, including nested rows like ``6_…_scaling.workers_8``).
+- It compares the **newest** round against the **previous** one with a
+  per-config tolerance band: a drop fails only when it exceeds
+  ``--tolerance`` (default 15%) *plus* the configs' own measured
+  run-to-run spread (each bench value carries
+  ``spread = (max - min) / median`` over its reps — a noisy config
+  earns a wider band, a tight config a narrow one).
+- Configs present in only one of the two rounds are reported but never
+  fail (new benchmarks appear, old ones retire).
+- ``--list`` prints the full round-over-round trajectory table
+  instead of judging.
+
+It only ever *parses* the JSONs — it never invokes ``bench.py`` — so
+the tier-1 wrapper (``tests/test_bench_regression.py``) stays fast.
+
+Usage::
+
+    python scripts/check_bench_regression.py            # newest vs prior
+    python scripts/check_bench_regression.py --list     # trajectory table
+    python scripts/check_bench_regression.py --dir /path --tolerance 0.2
+
+Exit status: 0 = no regression (or fewer than two rounds), 1 = at
+least one config dropped past its band, 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Leaf keys that mean "bigger is better, guard me".
+THROUGHPUT_KEYS = ("records_per_sec", "mb_per_sec", "staged_records_per_sec")
+# Leaf key carrying the measured run-to-run spread for a sibling value.
+SPREAD_OF = {
+    "records_per_sec": "spread",
+    "mb_per_sec": "spread",
+    "staged_records_per_sec": "staged_spread",
+}
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_rounds(bench_dir: str) -> List[Tuple[int, str]]:
+    """(round number, path), ascending."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            rounds.append((int(m.group(1)), path))
+    return sorted(rounds)
+
+
+def load_doc(path: str) -> Dict[str, Any]:
+    """One round's bench JSON line. The harness wraps bench.py's own
+    output under ``"parsed"``; a bare bench.py line is accepted too."""
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if isinstance(parsed, dict):
+        doc = parsed
+    return doc if isinstance(doc, dict) else {}
+
+
+def load_series(path: str) -> Dict[str, Tuple[float, float]]:
+    """Every guarded throughput series of one round: the per-config
+    leaves plus the top-level primary metric (the only series early
+    rounds carried — pre-``configs`` BENCH jsons hold just
+    ``{"metric", "value", "unit"}``)."""
+    doc = load_doc(path)
+    configs = doc.get("configs")
+    out = extract_series(configs if isinstance(configs, dict) else {})
+    metric = doc.get("metric")
+    value = doc.get("value")
+    if isinstance(metric, str) and isinstance(value, (int, float)):
+        spread = doc.get("spread", 0.0)
+        if not isinstance(spread, (int, float)):
+            spread = 0.0
+        out[f"primary.{metric}"] = (float(value), float(spread))
+    return out
+
+
+def extract_series(configs: Dict[str, Any]) -> Dict[str, Tuple[float, float]]:
+    """Flatten every throughput leaf to ``{dotted.path: (value,
+    spread)}``. Spread defaults to 0.0 when the config did not record
+    one."""
+    out: Dict[str, Tuple[float, float]] = {}
+
+    def walk(node: Any, prefix: str) -> None:
+        if not isinstance(node, dict):
+            return
+        for key, val in node.items():
+            path = f"{prefix}.{key}" if prefix else key
+            if isinstance(val, dict):
+                walk(val, path)
+            elif key in THROUGHPUT_KEYS and isinstance(val, (int, float)):
+                spread = node.get(SPREAD_OF[key], 0.0)
+                if not isinstance(spread, (int, float)):
+                    spread = 0.0
+                out[path] = (float(val), float(spread))
+
+    walk(configs, "")
+    return out
+
+
+def compare(prev: Dict[str, Tuple[float, float]],
+            new: Dict[str, Tuple[float, float]],
+            tolerance: float) -> Tuple[List[str], List[str]]:
+    """(failures, notes): a config fails when its relative drop
+    exceeds ``tolerance + max(spread_prev, spread_new)`` — its
+    personal tolerance band."""
+    failures: List[str] = []
+    notes: List[str] = []
+    for path in sorted(set(prev) | set(new)):
+        if path not in prev:
+            notes.append(f"new config (not judged): {path} = "
+                         f"{new[path][0]:,.1f}")
+            continue
+        if path not in new:
+            notes.append(f"config disappeared (not judged): {path}")
+            continue
+        pv, ps = prev[path]
+        nv, ns = new[path]
+        if pv <= 0:
+            continue
+        drop = 1.0 - nv / pv
+        band = tolerance + max(ps, ns)
+        line = (f"{path}: {pv:,.1f} -> {nv:,.1f} "
+                f"({-drop * 100:+.1f}%, band ±{band * 100:.1f}%)")
+        if drop > band:
+            failures.append(line)
+        else:
+            notes.append("ok  " + line)
+    return failures, notes
+
+
+def trajectory_table(rounds: List[Tuple[int, str]]) -> str:
+    """Round-over-round value table for every throughput series."""
+    series: Dict[str, Dict[int, float]] = {}
+    for rnd, path in rounds:
+        for key, (val, _s) in load_series(path).items():
+            series.setdefault(key, {})[rnd] = val
+    if not series:
+        return "no throughput series found\n"
+    name_w = max(len(k) for k in series)
+    nums = [r for r, _ in rounds]
+    head = f"{'config':<{name_w}}  " + " ".join(f"{'r%02d' % r:>12}"
+                                                for r in nums)
+    lines = [head]
+    for key in sorted(series):
+        row = [f"{key:<{name_w}} "]
+        for r in nums:
+            v = series[key].get(r)
+            row.append(f"{v:>12,.0f}" if v is not None else f"{'—':>12}")
+        lines.append(" ".join(row))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare the newest BENCH_r*.json against the "
+                    "prior round with per-config tolerance bands")
+    ap.add_argument("--dir", default=REPO,
+                    help="directory holding BENCH_r*.json (default: "
+                         "repo root)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="base allowed throughput drop before a "
+                         "config's own spread is added (default 0.15)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the full trajectory table, judge "
+                         "nothing")
+    args = ap.parse_args(argv)
+
+    rounds = find_rounds(args.dir)
+    if args.list:
+        sys.stdout.write(trajectory_table(rounds))
+        return 0
+    if len(rounds) < 2:
+        print(f"check_bench_regression: only {len(rounds)} round(s) in "
+              f"{args.dir}; nothing to compare")
+        return 0
+
+    (prev_n, prev_path), (new_n, new_path) = rounds[-2], rounds[-1]
+    prev = load_series(prev_path)
+    new = load_series(new_path)
+    if not new:
+        print(f"check_bench_regression: {os.path.basename(new_path)} "
+              "holds no throughput configs")
+        return 2
+    failures, notes = compare(prev, new, args.tolerance)
+
+    print(f"check_bench_regression: r{prev_n:02d} -> r{new_n:02d} "
+          f"({len(new)} series, tolerance {args.tolerance:.0%} + spread)")
+    for n in notes:
+        print(f"  {n}")
+    if failures:
+        print(f"REGRESSION: {len(failures)} config(s) dropped past "
+              "their band")
+        for f_ in failures:
+            print(f"  FAIL {f_}")
+        return 1
+    print("OK: no config dropped past its tolerance band")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,9 @@ README = os.path.join(REPO, "README.md")
 ALLOWED_PREFIXES = {
     "executor", "writer", "retry", "errors", "quarantine", "fsw",
     "codec", "bam", "sam", "vcf", "bcf", "cram", "sort", "telemetry",
+    # Live introspection (runtime/introspect.py): heartbeat-watchdog
+    # stall events and the /progress feed.
+    "watchdog", "progress",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

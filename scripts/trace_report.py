@@ -17,13 +17,23 @@ Output (stdout):
   wall-clock go";
 - **top-K straggler shards** by busy seconds.
 
+Watchdog stall events (``watchdog.stall`` spans) render as ``!`` bars
+painted over the stage they interrupted, stage-attributed via labels;
+when a meta line records nonzero ``dropped_spans`` (the in-memory span
+ring overflowed), a warning banner flags that ring-derived timelines
+are truncated.
+
 Usage::
 
     python scripts/trace_report.py spans.jsonl [--top 5] [--width 80]
         [--run RUN_ID] [--chrome out.json]
+    python scripts/trace_report.py progress.jsonl --progress
 
 ``--chrome`` additionally converts the spans to Chrome/Perfetto
 ``trace_event`` JSON (open in chrome://tracing or ui.perfetto.dev).
+``--progress`` instead replays a progress JSONL
+(``DisqOptions.progress_log``) into a per-direction
+throughput-over-time ASCII sparkline.
 """
 
 from __future__ import annotations
@@ -52,6 +62,11 @@ CATEGORIES = (
     ("emit_stall", "s", ("executor.emit.stall", "writer.emit.stall")),
     ("retry", "r", ("retry.",)),
     ("quarantine", "q", ("quarantine.",)),
+    # Watchdog stall events paint last (highest z): a flagged hang must
+    # never be hidden under the stage bar it interrupted. The span's
+    # duration is the silent age at detection, so the '!' bar covers
+    # exactly the dead air, stage-attributed via its labels.
+    ("watchdog", "!", ("watchdog.",)),
 )
 
 
@@ -66,9 +81,16 @@ def category_of(name: str) -> Optional[str]:
 def load_spans(path: str, run: Optional[str] = None):
     """Spans + meta records from one JSONL, optionally filtered to one
     run id (default: the LAST run seen — the usual 'report on the read
-    I just did' case when several runs appended to one file)."""
+    I just did' case when several runs appended to one file).
+
+    Also returns the total ``dropped_spans`` recorded by any meta
+    trailer line: nonzero means the in-memory span ring overflowed
+    while this log was being written, so ring-derived views (``/spans``,
+    chrome export of the ring) were truncated — the report surfaces it
+    as a banner instead of silently rendering a partial waterfall."""
     spans: List[Dict[str, Any]] = []
     runs: List[str] = []
+    dropped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -81,6 +103,9 @@ def load_spans(path: str, run: Optional[str] = None):
             if rec.get("meta"):
                 if rec.get("run_id") and rec["run_id"] not in runs:
                     runs.append(rec["run_id"])
+                d = rec.get("dropped_spans")
+                if isinstance(d, (int, float)):
+                    dropped = max(dropped, int(d))
                 continue
             if "name" not in rec or "ts" not in rec:
                 continue
@@ -91,7 +116,7 @@ def load_spans(path: str, run: Optional[str] = None):
         run = runs[-1]
     if run is not None:
         spans = [s for s in spans if s.get("run") == run]
-    return spans, run, runs
+    return spans, run, runs, dropped
 
 
 def percentile(sorted_vals: List[float], p: float) -> float:
@@ -159,13 +184,19 @@ def build_waterfall(spans, width: int) -> List[str]:
     return [span_line, legend, ""] + rows
 
 
-def report(spans, run, runs, top: int, width: int) -> str:
+def report(spans, run, runs, top: int, width: int,
+           dropped: int = 0) -> str:
     out: List[str] = []
     if not spans:
         return "no spans found (empty or filtered-out trace)\n"
     out.append(f"run {run}  ({len(spans)} spans"
                + (f"; file holds runs: {', '.join(runs)}" if len(runs) > 1
                   else "") + ")")
+    if dropped:
+        out.append(
+            f"WARNING: span ring overflowed ({dropped} spans dropped "
+            "from the in-memory ring) — ring-derived timelines "
+            "(/spans, chrome export of the ring) are truncated")
     out.append("")
 
     # -- waterfall ---------------------------------------------------------
@@ -226,12 +257,118 @@ def report(spans, run, runs, top: int, width: int) -> str:
     return "\n".join(out) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# --progress: replay a progress JSONL (DisqOptions.progress_log) into a
+# throughput-over-time sparkline
+# ---------------------------------------------------------------------------
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def load_progress(path: str, run: Optional[str] = None):
+    """Progress lines from one JSONL (written by
+    ``runtime/introspect.py``), filtered to one run id (default: the
+    last run seen)."""
+    recs: List[Dict[str, Any]] = []
+    runs: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line
+            rid = rec.get("run_id")
+            if rid and rid not in runs:
+                runs.append(rid)
+            if rec.get("meta") or "direction" not in rec:
+                continue
+            recs.append(rec)
+    if run is None and runs:
+        run = runs[-1]
+    if run is not None:
+        recs = [r for r in recs if r.get("run_id") == run]
+    return recs, run, runs
+
+
+def sparkline(values: List[float], width: int) -> str:
+    """Bucket ``values`` (already time-ordered) into ``width`` columns,
+    rendering each bucket's max as a block glyph."""
+    if not values:
+        return ""
+    if len(values) <= width:
+        buckets = [float(v) for v in values]
+    else:
+        buckets = []
+        for i in range(width):
+            lo = i * len(values) // width
+            hi = max(lo + 1, (i + 1) * len(values) // width)
+            buckets.append(max(values[lo:hi]))
+    peak = max(buckets)
+    if peak <= 0:
+        return SPARK_BLOCKS[0] * len(buckets)
+    return "".join(
+        SPARK_BLOCKS[min(len(SPARK_BLOCKS) - 1,
+                         int(v / peak * (len(SPARK_BLOCKS) - 1) + 0.5))]
+        for v in buckets)
+
+
+def fmt_rate(v: float) -> str:
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M/s"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}k/s"
+    return f"{v:.1f}/s"
+
+
+def progress_report(recs, run, runs, width: int) -> str:
+    """Per-direction throughput-over-time replay of a progress JSONL."""
+    if not recs:
+        return "no progress records found (empty or filtered-out log)\n"
+    out: List[str] = []
+    out.append(f"progress replay: run {run}  ({len(recs)} samples"
+               + (f"; file holds runs: {', '.join(runs)}" if len(runs) > 1
+                  else "") + ")")
+    by_dir: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for r in recs:
+        by_dir[r["direction"]].append(r)
+    for direction in sorted(by_dir):
+        rows = sorted(by_dir[direction], key=lambda r: r.get("mono", 0.0))
+        rates = [float(r.get("records_per_sec") or 0.0) for r in rows]
+        if not any(rates):
+            rates = [float(r.get("shards_per_sec") or 0.0) for r in rows]
+            unit = "shards/sec"
+        else:
+            unit = "records/sec"
+        last = rows[-1]
+        t0, t1 = rows[0].get("mono", 0.0), rows[-1].get("mono", 0.0)
+        out.append("")
+        out.append(
+            f"  [{direction}] {unit} over {max(0.0, t1 - t0):.2f}s  "
+            f"(peak {fmt_rate(max(rates) if rates else 0.0)}, "
+            f"final {fmt_rate(rates[-1] if rates else 0.0)})")
+        out.append("    " + sparkline(rates, width))
+        eta = last.get("eta_s")
+        out.append(
+            f"    shards {last.get('shards_done', '?')}/"
+            f"{last.get('shards_total', '?')} done, "
+            f"{last.get('in_flight', 0)} in flight, "
+            f"{last.get('records', 0):,} records"
+            + (f", eta {eta:.1f}s" if isinstance(eta, (int, float)) and eta
+               else ""))
+    return "\n".join(out) + "\n"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-shard waterfall + latency report from a "
                     "disq_tpu span JSONL")
     ap.add_argument("jsonl", help="span log written via "
-                    "DISQ_TPU_TRACE_JSONL / DisqOptions.span_log")
+                    "DISQ_TPU_TRACE_JSONL / DisqOptions.span_log "
+                    "(or, with --progress, a DisqOptions.progress_log "
+                    "JSONL)")
     ap.add_argument("--top", type=int, default=5,
                     help="straggler shards to list (default 5)")
     ap.add_argument("--width", type=int, default=72,
@@ -240,10 +377,20 @@ def main(argv=None) -> int:
                     help="run id to report (default: last run in file)")
     ap.add_argument("--chrome", default=None, metavar="OUT.json",
                     help="also write Chrome/Perfetto trace_event JSON")
+    ap.add_argument("--progress", action="store_true",
+                    help="treat the input as a progress JSONL "
+                    "(DisqOptions.progress_log) and replay it as a "
+                    "throughput-over-time sparkline")
     args = ap.parse_args(argv)
 
-    spans, run, runs = load_spans(args.jsonl, args.run)
-    sys.stdout.write(report(spans, run, runs, args.top, args.width))
+    if args.progress:
+        recs, run, runs = load_progress(args.jsonl, args.run)
+        sys.stdout.write(progress_report(recs, run, runs, args.width))
+        return 0
+
+    spans, run, runs, dropped = load_spans(args.jsonl, args.run)
+    sys.stdout.write(report(spans, run, runs, args.top, args.width,
+                            dropped))
     if args.chrome:
         sys.path.insert(
             0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,122 @@
+"""Tier-1 guard for ``scripts/check_bench_regression.py``: the
+trajectory comparator must pass the repo's real BENCH_r*.json history,
+fail a synthetic regressed round, and honor each config's measured
+spread — all from fixture JSONs, never by invoking bench.py."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_bench_regression.py")
+
+
+@pytest.fixture(scope="module")
+def cbr():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_bench_regression as mod
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _round(tmp_path, n, *, primary=100_000.0, spread=0.02, configs=None):
+    """Write one harness-shaped BENCH_rNN.json fixture."""
+    doc = {"n": n, "rc": 0, "parsed": {
+        "metric": "bam_decode_records_per_sec", "value": primary,
+        "unit": "records/sec", "spread": spread,
+        "configs": configs or {},
+    }}
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def test_repo_trajectory_passes():
+    """Acceptance: the existing BENCH_r01..r05 trajectory is green."""
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK: no config dropped" in proc.stdout
+
+
+def test_repo_list_prints_trajectory():
+    proc = subprocess.run([sys.executable, SCRIPT, "--list"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "primary.bam_decode_records_per_sec" in proc.stdout
+    # every round of the history shows as a column
+    for col in ("r01", "r05"):
+        assert col in proc.stdout
+
+
+def test_regressed_fixture_fails(cbr, tmp_path):
+    """Acceptance: a synthetic 30% drop past the band exits nonzero
+    and names the config."""
+    cfg1 = {"6_scaling": {"workers_8": {"records_per_sec": 800_000.0,
+                                        "spread": 0.02}}}
+    cfg2 = {"6_scaling": {"workers_8": {"records_per_sec": 560_000.0,
+                                        "spread": 0.02}}}
+    _round(tmp_path, 1, configs=cfg1)
+    _round(tmp_path, 2, configs=cfg2)
+    rc = cbr.main(["--dir", str(tmp_path)])
+    assert rc == 1
+
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "REGRESSION" in proc.stdout
+    assert "6_scaling.workers_8.records_per_sec" in proc.stdout
+
+
+def test_small_drop_within_band_passes(cbr, tmp_path):
+    _round(tmp_path, 1, primary=100_000.0)
+    _round(tmp_path, 2, primary=92_000.0)  # -8% < 15% band
+    assert cbr.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_spread_widens_the_band(cbr, tmp_path):
+    """A 25% drop fails a tight config but passes one whose own
+    measured spread is 0.2 — the band honors per-config noise."""
+    noisy1 = {"x": {"records_per_sec": 100_000.0, "spread": 0.2}}
+    noisy2 = {"x": {"records_per_sec": 75_000.0, "spread": 0.2}}
+    _round(tmp_path, 1, configs=noisy1)
+    _round(tmp_path, 2, configs=noisy2)
+    assert cbr.main(["--dir", str(tmp_path)]) == 0  # 25% < 15% + 20%
+
+    tight = tmp_path / "tight"
+    tight.mkdir()
+    tight1 = {"x": {"records_per_sec": 100_000.0, "spread": 0.01}}
+    tight2 = {"x": {"records_per_sec": 75_000.0, "spread": 0.01}}
+    _round(tight, 1, configs=tight1)
+    _round(tight, 2, configs=tight2)
+    assert cbr.main(["--dir", str(tight)]) == 1  # 25% > 15% + 1%
+
+
+def test_staged_rows_use_their_own_spread_key(cbr, tmp_path):
+    """bench config 8 carries staged_records_per_sec/staged_spread —
+    the extractor must pair them, not borrow the local row's spread."""
+    cfg = {"8_write": {"workers_4": {
+        "records_per_sec": 200_000.0, "spread": 0.01,
+        "staged_records_per_sec": 90_000.0, "staged_spread": 0.3,
+    }}}
+    series = cbr.extract_series(cfg)
+    assert series["8_write.workers_4.records_per_sec"] == (200_000.0, 0.01)
+    assert series["8_write.workers_4.staged_records_per_sec"] == (
+        90_000.0, 0.3)
+
+
+def test_new_and_retired_configs_never_fail(cbr, tmp_path):
+    _round(tmp_path, 1, configs={"old": {"records_per_sec": 1000.0}})
+    _round(tmp_path, 2, configs={"new": {"records_per_sec": 5.0}})
+    assert cbr.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_single_round_is_a_noop(cbr, tmp_path):
+    _round(tmp_path, 1)
+    assert cbr.main(["--dir", str(tmp_path)]) == 0

@@ -280,14 +280,17 @@ def test_chaos_soak_smoke(executor_workers):
     here; the script scales N up for real soak runs. The second
     parameterization soaks the parallel shard executor: fault firing
     order becomes thread-dependent, but the recovery contract (byte
-    identity / bounded loss / strict raise) must hold regardless."""
+    identity / bounded loss / strict raise) must hold regardless —
+    and, with --watchdog (parallel leg), the heartbeat watchdog must
+    flag the guaranteed write-side stall each iteration injects."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
     proc = subprocess.run(
         [sys.executable, script, "--iterations", "3", "--records", "200",
          "--seed", "7", "--executor-workers", str(executor_workers),
-         "--writer-workers", str(executor_workers)],
+         "--writer-workers", str(executor_workers)]
+        + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )

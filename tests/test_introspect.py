@@ -1,0 +1,493 @@
+"""Live introspection (``runtime/introspect.py``): heartbeat watchdog
+(stall detection on both pipeline directions, warn vs abort policies),
+the /metrics·/healthz·/progress·/spans endpoint, the progress JSONL +
+``trace_report --progress`` replay, the run_id ledger correlation, and
+the zero-overhead guarantee of the disabled path."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+from disq_tpu import DisqOptions, ReadsStorage, WatchdogStallError
+from disq_tpu.api import SbiWriteOption
+from disq_tpu.fsw import (
+    FaultInjectingFileSystemWrapper,
+    FaultSpec,
+    PosixFileSystemWrapper,
+    register_filesystem,
+)
+from disq_tpu.runtime import introspect
+from disq_tpu.runtime.introspect import (
+    HEALTH,
+    introspect_address,
+    reset_introspection,
+    start_introspect_server,
+)
+from disq_tpu.runtime.tracing import counter, spans
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Header/stream reads go through a 256 KiB readahead window, so a
+# stall fault targeted at a byte past it can only fire inside a
+# split's fetch stage (the heartbeated pipeline work).
+HEADER_READAHEAD = 256 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean_introspection():
+    reset_introspection()
+    yield
+    reset_introspection()
+
+
+@pytest.fixture(scope="module")
+def big_bam(tmp_path_factory):
+    """A BAM large enough that a mid-file byte lies past the header
+    readahead, written through the framework WITH its .sbi so split
+    boundaries come from the index (no driver-side guess reads touch
+    the target byte)."""
+    tmp = tmp_path_factory.mktemp("introspect")
+    raw_path = tmp / "raw.bam"
+    raw_path.write_bytes(
+        make_bam_bytes(DEFAULT_REFS, synth_records(5000, seed=11)))
+    ds = ReadsStorage.make_default().read(str(raw_path))
+    path = tmp / "stall.bam"
+    ReadsStorage.make_default().num_shards(6).write(
+        ds, str(path), SbiWriteOption.ENABLE)
+    assert os.path.exists(str(path) + ".sbi")
+    size = os.path.getsize(path)
+    assert size > HEADER_READAHEAD + 64 * 1024, size
+    return str(path), size, 5000
+
+
+@pytest.fixture(scope="module")
+def small_bam(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("introspect-small")
+    path = tmp / "small.bam"
+    path.write_bytes(
+        make_bam_bytes(DEFAULT_REFS, synth_records(800, seed=3)))
+    return str(path), 800
+
+
+def _stall_read_storage(size, workers=4, policy="warn",
+                        stall_s=0.8, watchdog_s=0.15):
+    """A fault fs injecting ONE real stall into whichever split fetch
+    first covers a mid-file byte (past the header readahead), plus a
+    storage with the watchdog armed."""
+    target = max(size * 3 // 5, HEADER_READAHEAD + 32 * 1024)
+    assert target < size
+    fsw = FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(),
+        [FaultSpec(kind="stall", offset=target, stall_s=stall_s, times=1)],
+        scheme="stallfault")
+    register_filesystem("stallfault", fsw)
+    storage = (ReadsStorage.make_default().split_size(96 * 1024)
+               .executor_workers(workers).watchdog(watchdog_s, policy))
+    return storage, fsw
+
+
+class TestWatchdog:
+    def test_read_stall_flagged_within_window_and_healthz_degrades(
+            self, big_bam):
+        """Acceptance: a w=4 read with an injected FaultSpec stall
+        reports the stuck shard via watchdog.stalled_shards and a
+        degraded /healthz while the shard is still silent."""
+        path, size, n = big_bam
+        storage, fsw = _stall_read_storage(size, workers=4)
+        before = counter("watchdog.stalled_shards").total()
+
+        results, errors = [], []
+
+        def run():
+            try:
+                results.append(storage.read("stallfault://" + path))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        degraded = None
+        deadline = time.time() + 15
+        while time.time() < deadline and degraded is None:
+            h = HEALTH.healthz()
+            if h["status"] == "degraded":
+                degraded = h
+                break
+            time.sleep(0.01)
+        t.join(timeout=60)
+        assert not errors, errors
+        assert degraded is not None, "healthz never degraded mid-stall"
+        assert degraded["stalls"], degraded
+        stall = degraded["stalls"][0]
+        assert stall["direction"] == "read"
+        assert stall["stage"] == "fetch"
+        # flagged within the window: the shard was still inside its
+        # 0.8 s stall when /healthz saw it, so age < stall duration
+        assert stall["age_s"] < 0.8 + 0.5
+        assert [k for k, c in fsw.fired_counts() if k == "stall"]
+        # warn policy: the read completes, intact
+        assert results and results[0].count() == n
+        assert counter("watchdog.stalled_shards").total() > before
+        assert counter("watchdog.stalled_shards").value(stage="fetch") >= 1
+        # recovery: once the stall ends the verdict returns to ok
+        assert HEALTH.healthz()["status"] == "ok"
+        # the stall left a span naming shard and stage
+        stall_spans = [s for s in spans() if s["name"] == "watchdog.stall"]
+        assert stall_spans
+        assert stall_spans[-1]["labels"]["stage"] == "fetch"
+        assert "shard" in stall_spans[-1]["labels"]
+
+    def test_read_stall_abort_policy_raises_watchdog_error(self, big_bam):
+        """abort policy cancels through the first-error-abort path:
+        the read raises WatchdogStallError long before the stall would
+        have ended on its own."""
+        path, size, _ = big_bam
+        storage, _ = _stall_read_storage(
+            size, workers=4, policy="abort", stall_s=3.0, watchdog_s=0.15)
+        t0 = time.perf_counter()
+        with pytest.raises(WatchdogStallError) as ei:
+            storage.read("stallfault://" + path)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.5, f"abort took {elapsed}s (stall was 3s)"
+        assert ei.value.stage == "fetch"
+        assert ei.value.shard_id >= 0
+
+    def test_inline_w1_abort_delivered_at_stage_boundary(self, big_bam):
+        """abort must not silently degrade to warn on the default
+        workers=1 inline path: with no pipeline to inject into, the
+        watchdog parks the error and the run's own thread raises it at
+        its next stage boundary (here: right after the stalled fetch
+        returns)."""
+        path, size, _ = big_bam
+        storage, _ = _stall_read_storage(
+            size, workers=1, policy="abort", stall_s=0.6, watchdog_s=0.15)
+        with pytest.raises(WatchdogStallError) as ei:
+            storage.read("stallfault://" + path)
+        assert ei.value.stage == "fetch"
+
+    def test_inline_w1_write_abort_delivered(self, small_bam, tmp_path):
+        path, _ = small_bam
+        ds = ReadsStorage.make_default().read(path)
+        fsw = FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(),
+            [FaultSpec(kind="stall", op="write", probability=1.0,
+                       times=1, stall_s=0.6)],
+            scheme="wstall1")
+        register_filesystem("wstall1", fsw)
+        out = str(tmp_path / "out.bam")
+        with pytest.raises(WatchdogStallError):
+            (ReadsStorage.make_default().num_shards(6)
+             .watchdog(0.15, "abort").write(ds, "wstall1://" + out))
+
+    def test_write_stall_flagged_at_w4(self, small_bam, tmp_path):
+        """Write-direction acceptance: a stalled part staging at
+        writer_workers=4 is flagged by the watchdog (the first
+        write-side call is always a stage-worker part write)."""
+        path, n = small_bam
+        ds = ReadsStorage.make_default().read(path)
+        fsw = FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(),
+            [FaultSpec(kind="stall", op="write", probability=1.0,
+                       times=1, stall_s=0.8)],
+            scheme="wstall")
+        register_filesystem("wstall", fsw)
+        out = str(tmp_path / "out.bam")
+        before = counter("watchdog.stalled_shards").total()
+        (ReadsStorage.make_default().num_shards(6).writer_workers(4)
+         .watchdog(0.15, "warn").write(ds, "wstall://" + out))
+        assert counter("watchdog.stalled_shards").total() > before
+        assert counter("watchdog.stalled_shards").value(stage="stage") >= 1
+        # warn policy: the write still committed, readable and intact
+        assert ReadsStorage.make_default().read(out).count() == n
+
+    def test_watchdog_classified_permanent(self):
+        from disq_tpu.runtime.errors import is_transient
+
+        assert not is_transient(WatchdogStallError("x"))
+
+
+class TestDisabledIsNoop:
+    def test_no_threads_sockets_or_board_traffic(self, small_bam,
+                                                 monkeypatch):
+        """Acceptance: with introspection disabled the read creates no
+        introspection thread or socket, the executor takes the plain
+        inline/pipelined path, and the board sees nothing."""
+        monkeypatch.delenv("DISQ_TPU_INTROSPECT_PORT", raising=False)
+        path, n = small_bam
+        before = set(threading.enumerate())
+        storage = (ReadsStorage.make_default().split_size(64 * 1024)
+                   .executor_workers(4))
+        ds = storage.read(path)
+        assert ds.count() == n
+        new = {t.name for t in set(threading.enumerate()) - before}
+        assert not any(nm.startswith(("disq-introspect", "disq-watchdog"))
+                       for nm in new), new
+        assert ds.introspect_address() is None
+        assert introspect_address() is None
+        assert not HEALTH.has_active_runs()
+        assert HEALTH.progress()["directions"] == {}
+
+    def test_default_executor_has_no_health_and_stays_inline(self,
+                                                             monkeypatch):
+        monkeypatch.delenv("DISQ_TPU_INTROSPECT_PORT", raising=False)
+        from disq_tpu.runtime.executor import (
+            ShardTask,
+            executor_for_storage,
+        )
+
+        storage = ReadsStorage.make_default()
+        ex = executor_for_storage(storage)
+        assert ex._health is None
+        # workers=1 + no health: map_ordered returns the raw inline
+        # sequential generator — no wrapper, no threads, no queues.
+        it = ex.map_ordered([ShardTask(shard_id=0, fetch=lambda: 1,
+                                       decode=lambda v: v)])
+        assert it.__name__ == "_run_sequential"
+        assert [r.value for r in it] == [1]
+
+    def test_note_shard_counters_noop_when_dark(self):
+        from disq_tpu.runtime import ShardCounters
+
+        introspect.note_shard_counters(
+            "read", ShardCounters(records=10, bytes_compressed=5))
+        assert HEALTH.progress()["directions"] == {}
+
+
+class TestEndpoint:
+    def test_endpoints_serve_live_run_state_in_subprocess(self, small_bam):
+        """Acceptance: /metrics, /healthz, /progress and /spans served
+        from a run in a fresh subprocess, with the endpoint turned on
+        purely by DISQ_TPU_INTROSPECT_PORT (the env knob path)."""
+        path, n = small_bam
+        code = f"""
+import json, sys, urllib.request
+sys.path.insert(0, {REPO!r})
+from disq_tpu import ReadsStorage, introspect_address
+
+ds = (ReadsStorage.make_default().split_size(64 * 1024)
+      .executor_workers(2).watchdog(5.0).read({path!r}))
+assert ds.count() == {n}
+addr = ds.introspect_address()
+assert addr and addr == introspect_address(), addr
+
+body = urllib.request.urlopen(f"http://{{addr}}/metrics", timeout=10).read()
+text = body.decode()
+assert "disq_tpu_executor_fetch_seconds" in text, text[:400]
+assert "disq_tpu_progress_shards" in text, text[:400]
+
+h = json.load(urllib.request.urlopen(f"http://{{addr}}/healthz", timeout=10))
+assert h["status"] == "ok" and h["run_id"], h
+assert h["stall_events"] == 0, h
+
+p = json.load(urllib.request.urlopen(f"http://{{addr}}/progress", timeout=10))
+read = p["directions"]["read"]
+assert read["shards_done"] == read["shards_total"] > 0, p
+assert read["records"] == {n}, p
+assert read["bytes_compressed"] > 0, p
+
+s = json.load(urllib.request.urlopen(f"http://{{addr}}/spans?n=7", timeout=10))
+assert len(s["spans"]) == 7, len(s["spans"])
+assert s["dropped_spans"] == 0
+assert all("name" in sp and "ts" in sp for sp in s["spans"])
+
+try:
+    urllib.request.urlopen(f"http://{{addr}}/nope", timeout=10)
+except urllib.error.HTTPError as e:
+    assert e.code == 404
+else:
+    raise AssertionError("404 expected")
+print("ENDPOINTS-OK")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "DISQ_TPU_INTROSPECT_PORT": "0"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ENDPOINTS-OK" in proc.stdout
+
+    def test_healthz_degraded_is_http_503(self, big_bam):
+        path, size, _ = big_bam
+        addr = start_introspect_server(0)
+        storage, _ = _stall_read_storage(size, workers=4)
+        got = {}
+
+        def run():
+            got["ds"] = storage.read("stallfault://" + path)
+
+        t = threading.Thread(target=run)
+        t.start()
+        code = None
+        deadline = time.time() + 15
+        while time.time() < deadline and code is None:
+            try:
+                urllib.request.urlopen(f"http://{addr}/healthz", timeout=5)
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    code = 503
+                    doc = json.load(e)
+                    assert doc["status"] == "degraded"
+                    break
+            time.sleep(0.01)
+        t.join(timeout=60)
+        assert code == 503, "degraded healthz never returned 503"
+        assert "ds" in got
+
+    def test_server_idempotent_and_stoppable(self):
+        a = start_introspect_server(0)
+        assert start_introspect_server(0) == a  # second start: same addr
+        assert introspect_address() == a
+        reset_introspection()
+        assert introspect_address() is None
+
+
+class TestProgress:
+    def test_progress_log_written_and_replayable(self, small_bam,
+                                                 tmp_path):
+        path, n = small_bam
+        plog = str(tmp_path / "progress.jsonl")
+        ds = (ReadsStorage.make_default().split_size(32 * 1024)
+              .executor_workers(2).progress_log(plog).read(path))
+        assert ds.count() == n
+        recs = [json.loads(ln) for ln in open(plog).read().splitlines()]
+        metas = [r for r in recs if r.get("meta")]
+        lines = [r for r in recs if "direction" in r]
+        assert metas and metas[0]["kind"] == "progress"
+        assert lines, "no progress lines written"
+        last = [r for r in lines if r["direction"] == "read"][-1]
+        assert last["shards_done"] == last["shards_total"] > 0
+        assert last["records"] == n
+        assert {"in_flight", "records_per_sec", "elapsed_s",
+                "eta_s"} <= set(last)
+
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             plog, "--progress"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "progress replay" in proc.stdout
+        assert "[read]" in proc.stdout
+        assert f"{n:,} records" in proc.stdout
+
+    def test_progress_counters_booked(self, small_bam, tmp_path):
+        path, n = small_bam
+        plog = str(tmp_path / "p.jsonl")
+        before = counter("progress.records").total()
+        (ReadsStorage.make_default().split_size(64 * 1024)
+         .progress_log(plog).read(path))
+        assert counter("progress.records").total() - before == n
+        assert counter("progress.shards").value(direction="read") > 0
+
+
+class TestTraceReportStallRendering:
+    def test_watchdog_glyph_and_overflow_banner(self, tmp_path):
+        """Satellites: watchdog.stall renders as '!' on the waterfall
+        with stage attribution; a nonzero dropped_spans meta surfaces
+        the ring-overflow banner instead of a silent partial render."""
+        log = tmp_path / "spans.jsonl"
+        rows = [
+            {"meta": 1, "run_id": "r1", "epoch": 0.0, "mono": 0.0},
+            {"ts": 0.0, "dur": 0.4, "name": "executor.fetch",
+             "run": "r1", "labels": {"shard": 0}},
+            {"ts": 0.15, "dur": 0.25, "name": "watchdog.stall",
+             "run": "r1", "labels": {"shard": 0, "stage": "fetch",
+                                     "direction": "read"}},
+            {"ts": 0.4, "dur": 0.1, "name": "executor.decode",
+             "run": "r1", "labels": {"shard": 0}},
+            {"meta": 1, "run_id": "r1", "dropped_spans": 12},
+        ]
+        log.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             str(log), "--width", "40"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "WARNING: span ring overflowed (12 spans dropped" in out
+        assert "!=watchdog" in out          # legend
+        assert "!" in out.split("shard 0")[1].splitlines()[0]  # bar
+        assert "watchdog.stall" in out      # percentile table row
+
+    def test_no_banner_without_drops(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        rows = [
+            {"meta": 1, "run_id": "r1", "epoch": 0.0, "mono": 0.0},
+            {"ts": 0.0, "dur": 0.1, "name": "executor.fetch",
+             "run": "r1", "labels": {"shard": 0}},
+        ]
+        log.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"), str(log)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "ring overflowed" not in proc.stdout
+
+    def test_stop_span_log_writes_dropped_trailer(self, tmp_path):
+        from disq_tpu.runtime import tracing
+
+        tracing.stop_span_log()
+        tracing.reset_telemetry()
+        tracing.set_span_ring_capacity(4)
+        try:
+            log = tmp_path / "s.jsonl"
+            tracing.start_span_log(str(log))
+            for i in range(10):
+                tracing.record_span("executor.fetch", 0.001, shard=i)
+            tracing.stop_span_log()
+            recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+            trailer = [r for r in recs if r.get("dropped_spans")]
+            assert trailer and trailer[-1]["dropped_spans"] == 6
+            # A later sink in the same process must NOT inherit the
+            # earlier overflow: the trailer reports per-sink deltas,
+            # so a clean run gets no false truncation banner.
+            log2 = tmp_path / "s2.jsonl"
+            tracing.reset_spans()  # room in the ring: no real drops now
+            tracing.start_span_log(str(log2))
+            tracing.record_span("executor.fetch", 0.001, shard=0)
+            tracing.stop_span_log()
+            recs2 = [json.loads(ln)
+                     for ln in log2.read_text().splitlines()]
+            assert not [r for r in recs2 if r.get("dropped_spans")]
+        finally:
+            tracing.set_span_ring_capacity(tracing.DEFAULT_SPAN_RING)
+            tracing.reset_telemetry()
+
+
+class TestLedgerRunIdCorrelation:
+    def test_quarantine_entries_carry_run_id(self, tmp_path):
+        from disq_tpu import QuarantineManifest
+        from disq_tpu.runtime.tracing import RUN_ID
+
+        q = QuarantineManifest(str(tmp_path / "q"))
+        q.quarantine("a.bam", 100, b"AAA")
+        [entry] = q.entries
+        assert entry["run_id"] == RUN_ID
+        with open(q.path) as f:
+            lines = [json.loads(ln) for ln in f.read().splitlines()]
+        assert lines[0] == {"version": 1}  # header unchanged
+        assert lines[1]["run_id"] == RUN_ID
+
+    def test_stage_manifest_records_marking_run(self, tmp_path):
+        from disq_tpu import StageManifest
+        from disq_tpu.runtime.tracing import RUN_ID
+
+        path = str(tmp_path / "m.json")
+        m = StageManifest(path, params={"x": 1})
+        m.mark_done("write.parts", 0, {"part": "p0"})
+        assert m.shard_run_id("write.parts", 0) == RUN_ID
+        # survives reload + join key persists on disk
+        r = StageManifest(path, params={"x": 1})
+        assert r.shard_info("write.parts", 0) == {"part": "p0"}
+        assert r.shard_run_id("write.parts", 0) == RUN_ID
+        doc = json.load(open(path))
+        assert doc["run_id"] == RUN_ID
