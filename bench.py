@@ -456,7 +456,13 @@ def write_scaling_config(path: str, tmp: str, reps: int) -> dict:
 
 def device_inflate_config(path: str) -> dict:
     """Device-kernel row: SIMD Pallas inflate MB/s over the bench BAM's
-    BGZF blocks, real chip only (skipped on CPU-only hosts)."""
+    BGZF blocks, real chip only (skipped on CPU-only hosts).
+
+    Dispatch accounting comes from the ``device.*`` telemetry registry
+    the kernel wrappers book (``device.host_fallback_blocks``,
+    ``device.kernel_launches``, transfer-byte counters) — not from
+    ad-hoc dict plumbing — so the row's numbers are the same ones
+    ``/metrics`` and ``telemetry_report()`` expose."""
     import jax
 
     if jax.default_backend() != "tpu":
@@ -464,30 +470,39 @@ def device_inflate_config(path: str) -> dict:
     from disq_tpu.bgzf.codec import inflate_blocks_device
     from disq_tpu.bgzf.guesser import find_block_table
     from disq_tpu.fsw import PosixFileSystemWrapper
+    from disq_tpu.runtime.tracing import REGISTRY
 
     fs = PosixFileSystemWrapper()
     blocks = [b for b in find_block_table(fs, path) if b.usize > 0]
     with open(path, "rb") as f:
         data = f.read()
     total = sum(b.usize for b in blocks)
-    from disq_tpu.ops import inflate_simd
 
-    n_dev = sum(1 for b in blocks
-                if b.csize - 26 <= inflate_simd.MAX_DEVICE_CSIZE)
     inflate_blocks_device(data, blocks)  # compile + warm
+    fallback = REGISTRY.counter("device.host_fallback_blocks")
+    launches = REGISTRY.counter("device.kernel_launches")
+    h2d = REGISTRY.counter("device.bytes_to_device")
+    d2h = REGISTRY.counter("device.bytes_to_host")
+    base = (fallback.total(), launches.total(), h2d.total(), d2h.total())
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
         inflate_blocks_device(data, blocks)
         times.append(time.perf_counter() - t0)
     med = statistics.median(times)
+    reps = len(times)
+    fell = int((fallback.total() - base[0]) / reps)
     return {
         "device_inflate": {
             "mb_per_sec": round(total / med / 1e6, 2),
             "raw_mb": round(total / 1e6, 2),
             "spread": _spread(times),
-            "device_served_blocks": n_dev,
-            "host_fallback_blocks": len(blocks) - n_dev,
+            "device_served_blocks": len(blocks) - fell,
+            "host_fallback_blocks": fell,
+            "kernel_launches": int(
+                (launches.total() - base[1]) / reps),
+            "bytes_to_device": int((h2d.total() - base[2]) / reps),
+            "bytes_to_host": int((d2h.total() - base[3]) / reps),
             # end-to-end number includes host<->device transfer; on the
             # axon dev tunnel H2D moves at ~12 MB/s, so kernel-side
             # throughput is recorded separately in TPU_KERNELS.json
@@ -561,6 +576,16 @@ def main() -> None:
     # BENCH_r*.json trajectory round over round).
     from disq_tpu.runtime.tracing import RUN_ID, telemetry_summary
 
+    telemetry = telemetry_summary()
+    # Device counter rollup pulled to its own key: the accelerator
+    # story (transfer bytes, launches, fallbacks, HBM peak) at a
+    # glance, without walking the full counters/gauges maps.
+    telemetry["device"] = {
+        k: v
+        for section in ("counters", "gauges")
+        for k, v in telemetry.get(section, {}).items()
+        if k.startswith("device.")
+    }
     print(
         json.dumps(
             {
@@ -572,7 +597,7 @@ def main() -> None:
                 "reps": REPS,
                 "run_id": RUN_ID,
                 "configs": configs,
-                "telemetry": telemetry_summary(),
+                "telemetry": telemetry,
             }
         )
     )

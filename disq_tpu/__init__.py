@@ -43,6 +43,7 @@ from disq_tpu.api import (  # noqa: F401
     StageManifestWriteOption,
 )
 from disq_tpu.runtime import (  # noqa: F401
+    ClusterAggregator,
     CorruptBlockError,
     DisqOptions,
     ErrorPolicy,
@@ -51,8 +52,11 @@ from disq_tpu.runtime import (  # noqa: F401
     ShardCounters,
     StageManifest,
     WatchdogStallError,
+    device_span,
     introspect_address,
     metrics_text,
+    process_count,
+    process_id,
     start_introspect_server,
     stop_introspect_server,
     phase_report,
@@ -60,6 +64,7 @@ from disq_tpu.runtime import (  # noqa: F401
     span,
     start_span_log,
     stop_span_log,
+    synced_timer,
     telemetry_snapshot,
     telemetry_summary,
     trace_phase,

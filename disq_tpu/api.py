@@ -46,11 +46,24 @@ def _telemetry_report(counters) -> dict:
     and where did the wall-clock go"."""
     from disq_tpu.runtime import tracing
     from disq_tpu.runtime.introspect import introspect_address
+    from disq_tpu.runtime.multihost import process_id
 
+    snapshot = tracing.telemetry_snapshot()
+    # The device-pipeline rollup (transfer bytes, kernel launches,
+    # host fallbacks, HBM peak) pulled out of the full snapshot so
+    # callers see the accelerator story without walking every metric.
+    device = {
+        name: series
+        for kind in snapshot.values()
+        for name, series in kind.items()
+        if name.startswith("device.")
+    }
     return {
         "run_id": tracing.RUN_ID,
+        "process_id": process_id(),
         "counters": counters.as_dict() if counters is not None else {},
-        "metrics": tracing.telemetry_snapshot(),
+        "metrics": snapshot,
+        "device": device,
         "phases": tracing.phase_report(),
         "gauges": tracing.gauge_report(),
         "span_log": tracing.span_log_path(),

@@ -75,9 +75,19 @@ def flagstat_counts(
                 f"axis {axis!r} not in mesh axes {mesh.axis_names}; pass "
                 "axis= explicitly for multi-axis meshes"
             )
+    from disq_tpu.runtime.tracing import (
+        count_transfer, device_span, hbm_resident)
+
     if mesh is None or mesh.shape[axis] <= 1 or len(flag) == 0:
-        out = _flagstat_single(jnp.asarray(flag.astype(np.int32)))
-        return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, np.asarray(out))}
+        staged = flag.astype(np.int32)
+        count_transfer("h2d", staged.nbytes)
+        with hbm_resident(staged.nbytes):
+            with device_span("device.kernel", kernel="flagstat",
+                             records=len(flag)) as fence:
+                out = fence.sync(_flagstat_single(jnp.asarray(staged)))
+            row = np.asarray(out)
+            count_transfer("d2h", row.nbytes)
+        return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}
     n_shards = mesh.shape[axis]
     per = -(-len(flag) // n_shards)
     padded = np.zeros(per * n_shards, dtype=np.int32)
@@ -85,24 +95,29 @@ def flagstat_counts(
     validity = np.zeros(per * n_shards, dtype=np.int32)
     validity[: len(flag)] = 1
     sharding = NamedSharding(mesh, P(axis, None))
-    fd = jax.device_put(padded.reshape(n_shards, per), sharding)
-    vd = jax.device_put(validity.reshape(n_shards, per), sharding)
+    count_transfer("h2d", padded.nbytes + validity.nbytes)
+    with hbm_resident(padded.nbytes + validity.nbytes):
+        fd = jax.device_put(padded.reshape(n_shards, per), sharding)
+        vd = jax.device_put(validity.reshape(n_shards, per), sharding)
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
 
-    def body(f, v):
-        local = _counts(f.reshape(-1), v.reshape(-1))
-        return lax.psum(local, axis)
+        def body(f, v):
+            local = _counts(f.reshape(-1), v.reshape(-1))
+            return lax.psum(local, axis)
 
-    out = jax.jit(
-        shard_map(
-            body, mesh=mesh,
-            in_specs=(P(axis, None), P(axis, None)),
-            out_specs=P(),
-        )
-    )(fd, vd)
-    row = np.asarray(out)
+        with device_span("device.kernel", kernel="flagstat",
+                         records=len(flag), shards=n_shards) as fence:
+            out = fence.sync(jax.jit(
+                shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(axis, None), P(axis, None)),
+                    out_specs=P(),
+                )
+            )(fd, vd))
+        row = np.asarray(out)
+        count_transfer("d2h", row.nbytes)
     return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}

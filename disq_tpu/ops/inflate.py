@@ -509,12 +509,23 @@ def inflate_payloads(
         us[:b] = usizes
     if interpret is None:
         interpret = not _on_tpu()
-    out, meta = inflate_stacked(
-        jnp.asarray(comp), jnp.asarray(cs), jnp.asarray(us),
-        interpret=interpret,
-    )
-    out = np.asarray(out)
-    meta = np.asarray(meta)
+    from disq_tpu.runtime.tracing import (
+        count_transfer, device_span, hbm_resident)
+
+    count_transfer("h2d", comp.nbytes + cs.nbytes + us.nbytes)
+    # Device residency: staged inputs + the (B, UMAX) i32 output slab.
+    with hbm_resident(comp.nbytes + cs.nbytes + us.nbytes
+                      + bb * UMAX * 4):
+        with device_span("device.kernel", kernel="inflate",
+                         blocks=b) as fence:
+            out, meta = inflate_stacked(
+                jnp.asarray(comp), jnp.asarray(cs), jnp.asarray(us),
+                interpret=interpret,
+            )
+            fence.sync(meta)
+        out = np.asarray(out)
+        meta = np.asarray(meta)
+        count_transfer("d2h", out.nbytes + meta.nbytes)
     results = []
     for i in range(b):
         if meta[i, 1] != 0:

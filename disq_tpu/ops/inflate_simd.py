@@ -70,6 +70,12 @@ from disq_tpu.ops.inflate import (
     _LEXT,
     _NLIT,
 )
+from disq_tpu.runtime.tracing import (
+    count_transfer as _count_transfer,
+    counter as _counter,
+    device_span as _device_span,
+    track_hbm as _track_hbm,
+)
 
 LANES = 128
 
@@ -916,6 +922,7 @@ def inflate_payloads_simd(
 
         def _host(p):
             last_stats["host_big"] += 1
+            _counter("device.host_fallback_blocks").inc(reason="oversize")
             try:
                 return _z.decompress(p, wbits=-15)
             except _z.error as e:
@@ -948,42 +955,67 @@ def inflate_payloads_simd(
               for lo in range(0, len(payloads), LANES)]
     window = 3
     launched: List = []
+    # Per-chunk device buffers live for the dispatch window; the
+    # footprint scope covers all concurrently launched chunks.
+    chunk_bytes = (cw + 1) * LANES * 4 + ow * LANES * 4 + 8 * LANES * 4
+    _track_hbm(min(window, len(chunks)) * chunk_bytes)
 
     def launch(chunk):
         comp, clen = _pack_chunk(chunk, cw)
+        _count_transfer("h2d", comp.nbytes + clen.nbytes)
         return fn(jnp.asarray(comp), jnp.asarray(clen), *consts)
 
-    for chunk in chunks[:window]:
-        launched.append(launch(chunk))
+    try:
+        for chunk in chunks[:window]:
+            launched.append(launch(chunk))
+
+        out: List[bytes] = []
+        for ci, chunk in enumerate(chunks):
+            lo = ci * LANES
+            words, meta = launched[ci]
+            # The materialize below is the chunk's real sync point
+            # (PROBES.md: asarray, not block_until_ready, fences) — the
+            # synced span covers the remaining kernel + D2H wait.
+            with _device_span("device.kernel", kernel="inflate_simd",
+                              lanes=len(chunk)) as fence:
+                words = np.asarray(fence.sync(words))
+                meta = np.asarray(meta)
+            _count_transfer("d2h", words.nbytes + meta.nbytes)
+            launched[ci] = None
+            if ci + window < len(chunks):
+                launched.append(launch(chunks[ci + window]))
+            out.extend(_unpack_chunk(chunk, lo, words, meta, usizes))
+    finally:
+        _track_hbm(-min(window, len(chunks)) * chunk_bytes)
+    return out
+
+
+def _unpack_chunk(chunk, lo, words, meta, usizes) -> List[bytes]:
+    """Slice one materialized chunk's lanes back into byte strings,
+    routing kernel-flagged lanes through the host-zlib fallback."""
+    import zlib
 
     out: List[bytes] = []
-    for ci, chunk in enumerate(chunks):
-        lo = ci * LANES
-        words, meta = launched[ci]
-        words = np.asarray(words)
-        meta = np.asarray(meta)
-        launched[ci] = None
-        if ci + window < len(chunks):
-            launched.append(launch(chunks[ci + window]))
-        for i, p in enumerate(chunk):
-            n, status = int(meta[0, i]), int(meta[1, i])
-            expect = None if usizes is None else int(usizes[lo + i])
-            if status != 0 or (expect is not None and n != expect):
-                last_stats["host_fallback"] += 1
-                try:
-                    host = zlib.decompress(p, wbits=-15)
-                except zlib.error as e:
-                    raise ValueError(
-                        f"corrupt DEFLATE stream: {e}") from e
-                if expect is not None and len(host) != expect:
-                    # genuine ISIZE mismatch (error 8) — the host path
-                    # raises here too; swallowing it would break the
-                    # cumulative-usize slicing in bam/source.py
-                    raise ValueError(
-                        f"device inflate failed: error 8 "
-                        f"(ISIZE {expect} != {len(host)})")
-                out.append(host)
-                continue
-            last_stats["device_lanes"] += 1
-            out.append(np.ascontiguousarray(words[:, i]).tobytes()[:n])
+    for i, p in enumerate(chunk):
+        n, status = int(meta[0, i]), int(meta[1, i])
+        expect = None if usizes is None else int(usizes[lo + i])
+        if status != 0 or (expect is not None and n != expect):
+            last_stats["host_fallback"] += 1
+            _counter("device.host_fallback_blocks").inc(reason="flagged")
+            try:
+                host = zlib.decompress(p, wbits=-15)
+            except zlib.error as e:
+                raise ValueError(
+                    f"corrupt DEFLATE stream: {e}") from e
+            if expect is not None and len(host) != expect:
+                # genuine ISIZE mismatch (error 8) — the host path
+                # raises here too; swallowing it would break the
+                # cumulative-usize slicing in bam/source.py
+                raise ValueError(
+                    f"device inflate failed: error 8 "
+                    f"(ISIZE {expect} != {len(host)})")
+            out.append(host)
+            continue
+        last_stats["device_lanes"] += 1
+        out.append(np.ascontiguousarray(words[:, i]).tobytes()[:n])
     return out

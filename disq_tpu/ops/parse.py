@@ -79,8 +79,34 @@ _FIELD_ORDER = (
 )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def parse_fixed_words_pallas(
+    words: jax.Array, interpret: bool = False
+) -> Dict[str, jax.Array]:
+    """Instrumented entry for the Pallas fixed-field parse kernel.
+
+    Called with concrete arrays (host entry) it books device telemetry
+    — ``device.kernel_launches{kernel=parse}``, transfer bytes for a
+    host-side input, and a synced ``device.kernel`` span (PROBES.md:
+    only materialization fences).  Called under an enclosing trace
+    (the device pipeline's jit) it is a passthrough: the outer caller
+    owns the accounting and no host sync is possible mid-trace."""
+    from jax.core import Tracer
+
+    if isinstance(words, Tracer):
+        return _parse_fixed_words_pallas(words, interpret=interpret)
+    from disq_tpu.runtime.tracing import count_transfer, device_span
+
+    nbytes = int(words.size) * words.dtype.itemsize
+    if not isinstance(words, jax.Array):
+        count_transfer("h2d", nbytes)
+    with device_span("device.kernel", kernel="parse",
+                     records=int(words.shape[0])) as fence:
+        return fence.sync(
+            _parse_fixed_words_pallas(words, interpret=interpret))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _parse_fixed_words_pallas(
     words: jax.Array, interpret: bool = False
 ) -> Dict[str, jax.Array]:
     """Pallas TPU kernel: grid over record tiles, each program parsing

@@ -214,15 +214,29 @@ def rans0_decode_device(streams: List[bytes], interpret=None) -> List[bytes]:
         cums_arr[i] = cum
     if interpret is None:
         interpret = not _on_tpu()
-    out, meta = rans0_decode_stacked(
-        jnp.asarray(body_arr), jnp.asarray(lookup_arr), jnp.asarray(raws),
-        jnp.asarray(clens), jnp.asarray(states0.astype(np.int32)),
-        jnp.asarray(freqs_arr), jnp.asarray(cums_arr),
-        body_rows=int(body_rows), out_rows=int(out_rows),
-        interpret=bool(interpret),
-    )
-    out = np.asarray(out)
-    meta = np.asarray(meta)
+    from disq_tpu.runtime.tracing import (
+        count_transfer, device_span, hbm_resident)
+
+    states32 = states0.astype(np.int32)  # the upload is the i32 cast
+    up = (body_arr.nbytes + lookup_arr.nbytes + raws.nbytes
+          + clens.nbytes + states32.nbytes + freqs_arr.nbytes
+          + cums_arr.nbytes)
+    count_transfer("h2d", up)
+    with hbm_resident(up + nb * out_rows * 128 * 4):
+        with device_span("device.kernel", kernel="rans",
+                         streams=n) as fence:
+            out, meta = rans0_decode_stacked(
+                jnp.asarray(body_arr), jnp.asarray(lookup_arr),
+                jnp.asarray(raws),
+                jnp.asarray(clens), jnp.asarray(states32),
+                jnp.asarray(freqs_arr), jnp.asarray(cums_arr),
+                body_rows=int(body_rows), out_rows=int(out_rows),
+                interpret=bool(interpret),
+            )
+            fence.sync(meta)
+        out = np.asarray(out)
+        meta = np.asarray(meta)
+        count_transfer("d2h", out.nbytes + meta.nbytes)
     results = []
     li = 0
     for orig, m in enumerate(metas):

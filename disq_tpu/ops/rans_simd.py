@@ -65,6 +65,7 @@ from disq_tpu.ops.inflate_simd import (
     _pack_chunk,
     _riota,
 )
+from disq_tpu.runtime.tracing import counter as _counter
 
 RANS_LOW = 1 << 23
 TF_SHIFT = 12
@@ -333,6 +334,7 @@ def rans0_decode_simd(
     # flight on device
     for k in big:
         last_stats["host_big"] += 1
+        _counter("device.host_fallback_blocks").inc(reason="oversize")
         out[k] = _host_decode0(streams[k])
     for ci, chunk in enumerate(chunks):
         words, meta = launched[ci]
@@ -345,6 +347,8 @@ def rans0_decode_simd(
             raw_size = metas[k][0]
             if int(meta[1, i]) != 0:
                 last_stats["host_fallback"] += 1
+                _counter("device.host_fallback_blocks").inc(
+                    reason="flagged")
                 out[k] = _host_decode0(streams[k])
             else:
                 last_stats["device_lanes"] += 1

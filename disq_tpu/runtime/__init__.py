@@ -36,6 +36,14 @@ protocol. JAX has no task retry, so the equivalents here are:
   ``DisqOptions.watchdog_stall_s`` (policy ``warn`` | ``abort``), and
   a progress/ETA reporter with an optional periodic JSONL log
   (``DisqOptions.progress_log``).
+- ``cluster`` — the cross-host half of observability: a
+  ``ClusterAggregator`` scraping N processes' introspection endpoints
+  and serving a merged ``/metrics`` / ``/progress`` / ``/healthz``
+  rollup with per-process labels (CLI:
+  ``scripts/metrics_aggregate.py``).
+- ``multihost`` — multi-process jax scaffold: axis planning, the
+  global (dcn, shards) mesh, and the ``process_id()`` identity every
+  introspection endpoint labels its output with.
 - ``debug`` — a debug mode (``DISQ_TPU_DEBUG=1``) asserting
   shard-boundary invariants (record counts, offset monotonicity)
   after each phase.
@@ -72,6 +80,14 @@ from disq_tpu.runtime.executor import (  # noqa: F401
     write_retrier_for_storage,
     writer_for_storage,
 )
+from disq_tpu.runtime.cluster import (  # noqa: F401
+    ClusterAggregator,
+    parse_metrics_text,
+)
+from disq_tpu.runtime.multihost import (  # noqa: F401
+    process_count,
+    process_id,
+)
 from disq_tpu.runtime.introspect import (  # noqa: F401
     HEALTH,
     PipelineHealth,
@@ -90,9 +106,14 @@ from disq_tpu.runtime.tracing import (  # noqa: F401
     REGISTRY,
     MetricsRegistry,
     chrome_trace_events,
+    count_transfer,
     counter,
+    device_span,
     export_chrome_trace,
     gauge,
+    hbm_resident,
+    synced_timer,
+    track_hbm,
     gauge_report,
     histogram,
     metrics_text,

@@ -1,0 +1,473 @@
+"""Cross-host metric aggregation — joining N processes' introspection.
+
+A multi-process run (``runtime/multihost.py``: one process per host)
+leaves N separate ``runtime/introspect.py`` endpoints, which is N
+browser tabs and no cluster answer to "how far along is the job".
+This module is the rollup: a :class:`ClusterAggregator` scrapes every
+worker's ``/metrics`` + ``/progress`` + ``/healthz``, merges them, and
+serves (or returns) the cluster view:
+
+- **Metrics.** Each worker's Prometheus exposition is parsed and
+  re-emitted with a ``process="<id>"`` label on every series (the id
+  comes from the ``disq_tpu_process_info`` series each endpoint
+  exposes, sourced from ``multihost.process_id()``), plus one
+  **rollup series per metric without the ``process`` label whose value
+  is the sum across processes** — counters sum to cluster totals,
+  histogram ``_bucket``/``_sum``/``_count`` series sum to cluster
+  histograms, gauges sum to cluster-wide levels (in-flight shards,
+  HBM bytes).
+- **Progress.** Per-direction shard/record/byte totals summed across
+  workers, rolling rates summed, ETA recomputed from the cluster
+  remaining/rate, with the per-process views kept under
+  ``"processes"``.
+- **Health.** ``ok`` only when every worker is reachable and ``ok``;
+  any degraded or unreachable worker degrades the cluster verdict and
+  is named.
+
+Everything is stdlib (``urllib`` + ``http.server``) and CPU-only
+testable: point it at N subprocess workers' ephemeral endpoints.
+The scrape itself is telemetry too: ``cluster.scrape`` spans (labeled
+with the endpoint), ``cluster.scrape_errors`` and the
+``cluster.processes`` reachable-worker gauge.
+
+CLI: ``scripts/metrics_aggregate.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from disq_tpu.runtime.tracing import REGISTRY, span
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+PROCESS_INFO_SERIES = "disq_tpu_process_info"
+
+
+def parse_metrics_text(text: str) -> Tuple[
+        Dict[str, str], List[Tuple[str, Tuple[Tuple[str, str], ...], float]]]:
+    """Parse a Prometheus text exposition into
+    ``({series_base_name: kind}, [(sample_name, labels, value), ...])``.
+
+    Handles exactly the exposition this framework emits (``# TYPE``
+    comments + plain samples; histogram samples appear as
+    ``name_bucket`` / ``name_sum`` / ``name_count`` under a ``# TYPE
+    name histogram``)."""
+    kinds: Dict[str, str] = {}
+    samples: List[Tuple[str, Tuple[Tuple[str, str], ...], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, _, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = tuple(
+            (k, v.replace('\\"', '"').replace("\\\\", "\\"))
+            for k, v in _LABEL_RE.findall(raw_labels or ""))
+        samples.append((name, labels, value))
+    return kinds, samples
+
+
+def _kind_of(sample_name: str, kinds: Dict[str, str]) -> str:
+    """The TYPE of one sample series, resolving histogram suffixes."""
+    if sample_name in kinds:
+        return kinds[sample_name]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if kinds.get(base) == "histogram":
+                return "histogram"
+    return "untyped"
+
+
+class WorkerState:
+    """One scraped worker: reachability, identity, parsed payloads."""
+
+    def __init__(self, endpoint: str) -> None:
+        self.endpoint = endpoint          # "host:port"
+        self.ok = False
+        self.error: Optional[str] = None
+        self.process_id: Optional[int] = None
+        self.run_id: Optional[str] = None
+        self.kinds: Dict[str, str] = {}
+        self.samples: List[Tuple[str, Tuple[Tuple[str, str], ...],
+                                 float]] = []
+        self.progress: Dict[str, Any] = {}
+        self.healthz: Dict[str, Any] = {}
+
+
+class ClusterAggregator:
+    """Scrape N introspection endpoints and merge (see module doc).
+
+    ``endpoints`` are ``host:port`` strings (scheme optional).
+    ``scrape()`` refreshes every worker synchronously and returns the
+    worker list; the ``metrics_text`` / ``progress`` / ``healthz``
+    views render the most recent scrape.  ``serve(port)`` starts an
+    HTTP server exposing the same three paths, scraping on demand
+    (throttled to at most one scrape per ``min_scrape_interval_s``).
+    """
+
+    def __init__(self, endpoints: Sequence[str], timeout_s: float = 5.0,
+                 min_scrape_interval_s: float = 0.2) -> None:
+        if not endpoints:
+            raise ValueError("at least one worker endpoint required")
+        self.endpoints = [e.strip() for e in endpoints if e.strip()]
+        self.timeout_s = timeout_s
+        self.min_scrape_interval_s = min_scrape_interval_s
+        self._lock = threading.Lock()
+        self._workers: List[WorkerState] = [
+            WorkerState(e) for e in self.endpoints]
+        self._last_scrape = 0.0
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._address: Optional[str] = None
+
+    # -- scraping -----------------------------------------------------------
+
+    def _get(self, endpoint: str, path: str) -> bytes:
+        base = endpoint
+        if "://" not in base:
+            base = "http://" + base
+        with urllib.request.urlopen(base + path,
+                                    timeout=self.timeout_s) as resp:
+            return resp.read()
+
+    def _scrape_one(self, worker: WorkerState) -> None:
+        with span("cluster.scrape", endpoint=worker.endpoint):
+            try:
+                metrics_raw = self._get(worker.endpoint,
+                                        "/metrics").decode()
+                progress_raw = self._get(worker.endpoint, "/progress")
+                try:
+                    healthz_raw = self._get(worker.endpoint, "/healthz")
+                except urllib.error.HTTPError as e:
+                    # /healthz answers 503 when degraded — that IS the
+                    # payload, not a scrape failure.
+                    healthz_raw = e.read()
+            except Exception as e:  # noqa: BLE001 — reachability verdict
+                worker.ok = False
+                worker.error = f"{type(e).__name__}: {e}"
+                REGISTRY.counter("cluster.scrape_errors").inc(
+                    endpoint=worker.endpoint)
+                return
+        worker.kinds, worker.samples = parse_metrics_text(metrics_raw)
+        try:
+            worker.progress = json.loads(progress_raw)
+        except ValueError:
+            worker.progress = {}
+        try:
+            worker.healthz = json.loads(healthz_raw)
+        except ValueError:
+            worker.healthz = {}
+        worker.process_id = self._identity(worker)
+        worker.run_id = worker.progress.get("run_id") \
+            or worker.healthz.get("run_id")
+        worker.ok = True
+        worker.error = None
+
+    @staticmethod
+    def _identity(worker: WorkerState) -> int:
+        """Worker process id: the process_info series first, then the
+        JSON endpoints, then the scrape-list position."""
+        for name, labels, _value in worker.samples:
+            if name == PROCESS_INFO_SERIES:
+                for k, v in labels:
+                    if k == "process_id":
+                        try:
+                            return int(v)
+                        except ValueError:
+                            break
+        for doc in (worker.progress, worker.healthz):
+            pid = doc.get("process_id")
+            if isinstance(pid, int):
+                return pid
+        return -1
+
+    def scrape(self) -> List[WorkerState]:
+        """Refresh every worker (concurrently — a dead worker's timeout
+        must not serialize the healthy ones) and return the states."""
+        with self._lock:
+            workers = [WorkerState(e) for e in self.endpoints]
+            threads = [
+                threading.Thread(target=self._scrape_one, args=(w,),
+                                 daemon=True)
+                for w in workers
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # Every worker ends up with a UNIQUE id: a reported id is
+            # kept first-come; duplicates (N workers all reporting
+            # jax.process_index()==0), missing ids and unreachable
+            # workers fall back to unused integers — otherwise two
+            # same-id workers would overwrite each other's process-
+            # labeled series and break the rollup-equals-sum contract.
+            taken = set()
+            for w in workers:
+                if (w.ok and isinstance(w.process_id, int)
+                        and w.process_id >= 0
+                        and w.process_id not in taken):
+                    taken.add(w.process_id)
+                else:
+                    w.process_id = None
+            next_free = 0
+            for w in workers:
+                if w.process_id is None:
+                    while next_free in taken:
+                        next_free += 1
+                    w.process_id = next_free
+                    taken.add(next_free)
+            self._workers = workers
+            self._last_scrape = time.perf_counter()
+            REGISTRY.gauge("cluster.processes").observe(
+                sum(1 for w in workers if w.ok))
+            return workers
+
+    def _fresh(self) -> List[WorkerState]:
+        with self._lock:
+            age = time.perf_counter() - self._last_scrape
+            if self._last_scrape and age < self.min_scrape_interval_s:
+                return self._workers
+        return self.scrape()
+
+    # -- merged views -------------------------------------------------------
+
+    def metrics_text(self, workers: Optional[List[WorkerState]] = None
+                     ) -> str:
+        """Merged Prometheus exposition: every worker series re-labeled
+        ``process="<id>"`` plus, for each (name, labels) series, one
+        rollup sample WITHOUT the process label holding the sum across
+        processes."""
+        if workers is None:
+            workers = self._fresh()
+        kinds: Dict[str, str] = {}
+        # sample name -> labelset(with process) -> value, and rollups
+        per_process: Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                                    float]] = defaultdict(dict)
+        rollup: Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                               float]] = defaultdict(lambda:
+                                                     defaultdict(float))
+        for w in workers:
+            if not w.ok:
+                continue
+            kinds.update(w.kinds)
+            for name, labels, value in w.samples:
+                if name == PROCESS_INFO_SERIES:
+                    continue
+                labeled = tuple(sorted(
+                    labels + (("process", str(w.process_id)),)))
+                per_process[name][labeled] = value
+                rollup[name][labels] += value
+
+        def fmt(labels: Tuple[Tuple[str, str], ...]) -> str:
+            if not labels:
+                return ""
+            body = ",".join(
+                '%s="%s"' % (k, v.replace("\\", "\\\\").replace(
+                    '"', '\\"')) for k, v in labels)
+            return "{" + body + "}"
+
+        def fmt_val(v: float) -> str:
+            return repr(round(v, 9)) if v != int(v) else str(int(v))
+
+        lines: List[str] = [
+            "# TYPE disq_tpu_cluster_workers gauge",
+            "disq_tpu_cluster_workers{state=\"ok\"} %d"
+            % sum(1 for w in workers if w.ok),
+            "disq_tpu_cluster_workers{state=\"unreachable\"} %d"
+            % sum(1 for w in workers if not w.ok),
+        ]
+        typed_done = set()
+        for name in sorted(per_process):
+            base_kind = _kind_of(name, kinds)
+            type_name = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if (base_kind == "histogram"
+                        and name.endswith(suffix)):
+                    type_name = name[: -len(suffix)]
+            if type_name not in typed_done and base_kind != "untyped":
+                lines.append(f"# TYPE {type_name} {base_kind}")
+                typed_done.add(type_name)
+            for labels in sorted(rollup[name]):
+                lines.append(
+                    f"{name}{fmt(labels)} "
+                    f"{fmt_val(rollup[name][labels])}")
+            for labels in sorted(per_process[name]):
+                lines.append(
+                    f"{name}{fmt(labels)} "
+                    f"{fmt_val(per_process[name][labels])}")
+        return "\n".join(lines) + "\n"
+
+    def progress(self, workers: Optional[List[WorkerState]] = None
+                 ) -> Dict[str, Any]:
+        """Cluster progress: per-direction totals summed across
+        workers, rates summed, ETA recomputed from cluster
+        remaining/rate; per-process views preserved."""
+        if workers is None:
+            workers = self._fresh()
+        directions: Dict[str, Dict[str, Any]] = {}
+        processes: Dict[str, Any] = {}
+        for w in workers:
+            key = str(w.process_id if w.process_id is not None else -1)
+            if not w.ok:
+                processes[key] = {"endpoint": w.endpoint,
+                                  "ok": False, "error": w.error}
+                continue
+            processes[key] = {"endpoint": w.endpoint, "ok": True,
+                              "run_id": w.run_id,
+                              "directions": w.progress.get(
+                                  "directions", {})}
+            for direction, view in (w.progress.get("directions")
+                                    or {}).items():
+                agg = directions.setdefault(direction, {
+                    "active": False, "shards_total": 0, "shards_done": 0,
+                    "in_flight": 0, "records": 0, "bytes_compressed": 0,
+                    "bytes_uncompressed": 0, "records_per_sec": 0.0,
+                    "shards_per_sec": 0.0, "elapsed_s": 0.0,
+                    "eta_s": None,
+                })
+                agg["active"] = agg["active"] or bool(view.get("active"))
+                for k in ("shards_total", "shards_done", "in_flight",
+                          "records", "bytes_compressed",
+                          "bytes_uncompressed"):
+                    agg[k] += int(view.get(k) or 0)
+                for k in ("records_per_sec", "shards_per_sec"):
+                    agg[k] = round(agg[k] + float(view.get(k) or 0.0), 3)
+                agg["elapsed_s"] = max(agg["elapsed_s"],
+                                       float(view.get("elapsed_s")
+                                             or 0.0))
+        for view in directions.values():
+            remaining = max(0, view["shards_total"] - view["shards_done"])
+            rate = view["shards_per_sec"]
+            if not remaining:
+                view["eta_s"] = 0.0
+            elif view["active"] and rate > 0:
+                view["eta_s"] = round(remaining / rate, 3)
+        return {
+            "cluster": True,
+            "workers_ok": sum(1 for w in workers if w.ok),
+            "workers_total": len(workers),
+            "directions": directions,
+            "processes": processes,
+        }
+
+    def healthz(self, workers: Optional[List[WorkerState]] = None
+                ) -> Dict[str, Any]:
+        """Cluster liveness: ok only when every worker is reachable and
+        itself ok; degraded/unreachable workers are named."""
+        if workers is None:
+            workers = self._fresh()
+        problems = []
+        for w in workers:
+            if not w.ok:
+                problems.append({"endpoint": w.endpoint,
+                                 "process_id": w.process_id,
+                                 "status": "unreachable",
+                                 "error": w.error})
+            elif w.healthz.get("status") not in (None, "ok"):
+                problems.append({"endpoint": w.endpoint,
+                                 "process_id": w.process_id,
+                                 "status": w.healthz.get("status"),
+                                 "stalls": w.healthz.get("stalls", [])})
+        return {
+            "status": "ok" if not problems else "degraded",
+            "cluster": True,
+            "workers_ok": sum(1 for w in workers if w.ok),
+            "workers_total": len(workers),
+            "problems": problems,
+        }
+
+    # -- serving ------------------------------------------------------------
+
+    def serve(self, port: int = 0) -> str:
+        """Serve the merged ``/metrics`` / ``/progress`` / ``/healthz``
+        on 127.0.0.1 (``port`` 0 = ephemeral); each request scrapes on
+        demand (throttled).  Returns the bound ``host:port``."""
+        if self._server is not None:
+            return self._address  # type: ignore[return-value]
+        aggregator = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            server_version = "disq-tpu-cluster/1"
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802
+                path = self.path.partition("?")[0]
+                workers = aggregator._fresh()
+                if path == "/metrics":
+                    self._send(
+                        200, aggregator.metrics_text(workers).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/progress":
+                    self._send(
+                        200,
+                        json.dumps(aggregator.progress(workers),
+                                   default=str).encode(),
+                        "application/json")
+                elif path == "/healthz":
+                    doc = aggregator.healthz(workers)
+                    self._send(
+                        200 if doc["status"] == "ok" else 503,
+                        json.dumps(doc, default=str).encode(),
+                        "application/json")
+                else:
+                    self._send(404, json.dumps({
+                        "error": "unknown path",
+                        "endpoints": ["/metrics", "/progress",
+                                      "/healthz"]}).encode(),
+                        "application/json")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", int(port)), _Handler)
+        srv.daemon_threads = True
+        self._server = srv
+        self._address = "127.0.0.1:%d" % srv.server_address[1]
+        self._server_thread = threading.Thread(
+            target=srv.serve_forever, name="disq-cluster", daemon=True)
+        self._server_thread.start()
+        return self._address
+
+    def close(self) -> None:
+        srv, thread = self._server, self._server_thread
+        self._server = None
+        self._server_thread = None
+        self._address = None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    @property
+    def address(self) -> Optional[str]:
+        return self._address

@@ -77,6 +77,8 @@ def run_device_pipeline(
     permutation, flagstat dict).
     """
     from disq_tpu.ops.flagstat import FLAGSTAT_FIELDS
+    from disq_tpu.runtime.tracing import (
+        count_transfer, device_span, hbm_resident, span)
 
     if len(offsets) <= 1:
         return (np.zeros(0, np.uint64), np.zeros(0, np.int32),
@@ -87,19 +89,42 @@ def run_device_pipeline(
             "pipeline indexes with i32 — split the shard below 2 GiB")
     pad = (-len(blob)) % 4
     if pad:
-        blob = np.concatenate([blob, np.zeros(pad, np.uint8)])
+        # Word-align with ONE preallocated buffer + tail write (the old
+        # np.concatenate built a temp list and a second full copy).
+        padded = np.empty(len(blob) + pad, np.uint8)
+        padded[: len(blob)] = blob
+        padded[len(blob):] = 0
+        blob = padded
     words_host = np.ascontiguousarray(blob).view("<u4")
-    # explicit uploads — the ONLY host->device transfers in the flow
-    blob_dev = jax.device_put(jnp.asarray(words_host))
-    starts_dev = jax.device_put(
-        jnp.asarray(offsets[:-1].astype(np.int32)))
-    with jax.transfer_guard("disallow"):
-        hi_k, lo_k, order, fs = _pipeline(
-            blob_dev, starts_dev, interpret=interpret)
-        jax.block_until_ready(fs)
-    # explicit results fetch
-    keys = (np.asarray(hi_k).astype(np.uint64) << np.uint64(32)) | \
-        np.asarray(lo_k).astype(np.uint64)
-    stats = {k: int(v)
-             for k, v in zip(FLAGSTAT_FIELDS, np.asarray(fs))}
-    return keys, np.asarray(order), stats
+    starts_host = np.ascontiguousarray(offsets[:-1].astype(np.int32))
+    # Upload accounting covers what actually moves: the word-aligned
+    # blob (pad bytes included) plus the starts vector.
+    up_bytes = words_host.nbytes + starts_host.nbytes
+    count_transfer("h2d", up_bytes)
+    with hbm_resident(up_bytes):
+        # explicit uploads — the ONLY host->device transfers in the flow
+        with span("device.transfer", direction="h2d", bytes=up_bytes):
+            blob_dev = jax.device_put(jnp.asarray(words_host))
+            starts_dev = jax.device_put(jnp.asarray(starts_host))
+        # device_span's close materializes a sentinel of fs — the true
+        # sync PROBES.md requires (block_until_ready alone does not
+        # block on this platform); the sentinel fetch happens outside
+        # the transfer guard, like the results fetch below.
+        with device_span("device.kernel", kernel="device_pipeline") as fence:
+            with jax.transfer_guard("disallow"):
+                hi_k, lo_k, order, fs = _pipeline(
+                    blob_dev, starts_dev, interpret=interpret)
+                jax.block_until_ready(fs)
+            fence.sync(fs)
+        # explicit results fetch
+        with span("device.transfer", direction="d2h"):
+            hi_np = np.asarray(hi_k)
+            lo_np = np.asarray(lo_k)
+            order_np = np.asarray(order)
+            fs_np = np.asarray(fs)
+        count_transfer("d2h", hi_np.nbytes + lo_np.nbytes
+                       + order_np.nbytes + fs_np.nbytes)
+    keys = (hi_np.astype(np.uint64) << np.uint64(32)) | \
+        lo_np.astype(np.uint64)
+    stats = {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, fs_np)}
+    return keys, order_np, stats

@@ -30,7 +30,12 @@ this module answers "is it still making progress *right now*":
   ``DISQ_TPU_INTROSPECT_PORT``; port 0 = ephemeral) serving
   ``/metrics`` (Prometheus exposition), ``/healthz`` (JSON liveness
   verdict), ``/progress`` (JSON progress view) and ``/spans`` (bounded
-  tail of the in-memory span ring).
+  tail of the in-memory span ring).  Every payload carries this
+  process's identity (``multihost.process_id()`` — a
+  ``disq_tpu_process_info`` series on ``/metrics``, a ``process_id``
+  key on the JSON endpoints) so a cluster aggregation
+  (``runtime/cluster.py``) can merge N workers with ``process``
+  labels.
 
 Zero overhead when disabled: with no endpoint, watchdog or progress
 log configured, ``configure_from_options`` returns ``None``, the
@@ -52,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from disq_tpu.runtime import tracing
 from disq_tpu.runtime.errors import WatchdogStallError
+from disq_tpu.runtime.multihost import process_id as _process_id
 from disq_tpu.runtime.tracing import RUN_ID, counter, record_span
 
 # Module lifecycle (server / monitor / progress sink) is guarded by one
@@ -543,13 +549,23 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         path, _, query = self.path.partition("?")
         if path == "/metrics":
-            self._send(200, tracing.metrics_text().encode(),
+            # The process-identity info series is what lets a cluster
+            # aggregator (runtime/cluster.py) tell N workers'
+            # expositions apart and label merged series process=<id>.
+            info = (
+                "# TYPE disq_tpu_process_info gauge\n"
+                'disq_tpu_process_info{process_id="%d",run_id="%s"} 1\n'
+                % (_process_id(), RUN_ID))
+            self._send(200, (info + tracing.metrics_text()).encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
             doc = HEALTH.healthz()
+            doc["process_id"] = _process_id()
             self._send_json(doc, 200 if doc["status"] == "ok" else 503)
         elif path == "/progress":
-            self._send_json(HEALTH.progress())
+            doc = HEALTH.progress()
+            doc["process_id"] = _process_id()
+            self._send_json(doc)
         elif path == "/spans":
             n = _SPANS_TAIL_DEFAULT
             for part in query.split("&"):

@@ -22,6 +22,7 @@ resulting meshes end to end.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -32,11 +33,52 @@ def plan_axes(n_devices_total: int, n_processes: int) -> Tuple[int, int]:
     per-host device count on the inner (ICI) axis."""
     if n_processes <= 0:
         raise ValueError("n_processes must be positive")
+    if n_devices_total <= 0:
+        raise ValueError("n_devices_total must be positive")
     if n_devices_total % n_processes:
         raise ValueError(
             f"{n_devices_total} devices do not split over "
             f"{n_processes} processes")
     return n_processes, n_devices_total // n_processes
+
+
+def process_id() -> int:
+    """This process's id in the multi-process job — the label every
+    introspection endpoint stamps on its output so a cluster
+    aggregation can tell N workers' metrics apart.
+
+    Resolution order: ``DISQ_TPU_PROCESS_ID`` (explicit override —
+    also how CPU-only subprocess tests and non-jax launchers assign
+    distinct ids), then ``jax.process_index()``, then 0."""
+    raw = os.environ.get("DISQ_TPU_PROCESS_ID")
+    if raw is not None and raw != "":
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — host-only deployments
+        return 0
+
+
+def process_count() -> int:
+    """Total processes in the job (``DISQ_TPU_PROCESS_COUNT`` override,
+    else ``jax.process_count()``, else 1)."""
+    raw = os.environ.get("DISQ_TPU_PROCESS_COUNT")
+    if raw is not None and raw != "":
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # noqa: BLE001
+        return 1
 
 
 def initialize(coordinator_address: Optional[str] = None,

@@ -46,6 +46,16 @@ against the README table):
 - ``bam.*`` / ``vcf.*`` / ``bcf.*`` / ``cram.*`` — format phases
   (``bam.read.header`` …) and per-split ``<fmt>.split.fetch`` /
   ``<fmt>.split.decode`` spans carrying shard id + byte range.
+- ``device.*`` — the device-resident pipeline and Pallas kernels:
+  ``device.bytes_to_device`` / ``device.bytes_to_host`` transfer
+  counters, ``device.kernel_launches{kernel=}``,
+  ``device.host_fallback_blocks{reason=}``, the ``device.hbm_bytes``
+  live-footprint gauge, and ``device.kernel`` / ``device.transfer``
+  spans.  Device spans are timed by ``device_span`` /
+  ``synced_timer``, which **materialize a sentinel element** of the
+  kernel's output before closing — PROBES.md: ``block_until_ready``
+  does not sync on this platform, so an unmaterialized timing
+  under-reports arbitrarily.
 - ``telemetry.*`` — self-observation (``telemetry.dropped_spans``).
 
 Back-compat: ``trace_phase`` / ``record_phase`` / ``phase_report`` /
@@ -646,8 +656,134 @@ def wrap_span(name: str, fn: Callable, **labels: Any) -> Callable:
 
 
 # ---------------------------------------------------------------------------
+# Device telemetry: synced kernel spans, transfer counters, HBM gauge
+# ---------------------------------------------------------------------------
+
+def _materialize_sentinel(value: Any) -> None:
+    """Truly wait for every jax array in ``value`` (a pytree) by
+    materializing ONE element of each.  ``block_until_ready`` does not
+    block on this platform (PROBES.md measurement caveats) — only
+    ``np.asarray`` syncs — so a sentinel fetch is the cheapest honest
+    fence: a one-element slice dispatches after the producing kernel
+    and costs a few bytes of D2H, not the whole result."""
+    try:
+        import jax
+        from jax.core import Tracer
+        import numpy as _np
+    except ImportError:  # host-only deployment: nothing to sync
+        return
+    for leaf in jax.tree_util.tree_leaves(value):
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, Tracer):
+            _np.asarray(leaf.ravel()[:1] if leaf.ndim else leaf)
+
+
+class _DeviceSync:
+    """Handle yielded by ``device_span``: the body registers its device
+    outputs with ``sync(...)``; span close materializes one sentinel
+    element of each so the recorded duration covers real execution."""
+
+    __slots__ = ("_values",)
+
+    def __init__(self) -> None:
+        self._values: List[Any] = []
+
+    def sync(self, *values: Any):
+        """Register device arrays (or pytrees of them) to fence on at
+        span close.  Returns the single value (or the tuple) so call
+        sites can wrap an expression in place."""
+        self._values.extend(values)
+        return values[0] if len(values) == 1 else values
+
+    def materialize(self) -> None:
+        for v in self._values:
+            _materialize_sentinel(v)
+        self._values.clear()
+
+
+@contextlib.contextmanager
+def device_span(name: str, **labels: Any) -> Iterator[_DeviceSync]:
+    """Span over device work whose close is a true sync point: the body
+    hands its output arrays to ``.sync(...)`` and span exit
+    materializes a one-element sentinel of each before taking the end
+    timestamp (the PROBES.md caveat: unmaterialized device timings
+    under-report arbitrarily).  Also books one
+    ``device.kernel_launches`` increment when a ``kernel=`` label is
+    present, so every synced kernel span is a counted launch."""
+    _resolve_span_env()
+    if "kernel" in labels:
+        REGISTRY.counter("device.kernel_launches").inc(
+            kernel=labels["kernel"])
+    handle = _DeviceSync()
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    finally:
+        handle.materialize()
+        _emit_span(name, t0, time.perf_counter() - t0, labels)
+
+
+def synced_timer(name: str, **labels: Any) -> Callable:
+    """Decorator form of ``device_span``: times the wrapped function
+    and materializes a sentinel of its return value before the span
+    closes — for ops entry points whose return IS the device output."""
+    def deco(fn: Callable) -> Callable:
+        def wrapped(*args: Any, **kwargs: Any):
+            with device_span(name, **labels) as fence:
+                return fence.sync(fn(*args, **kwargs))
+        return wrapped
+    return deco
+
+
+def count_transfer(direction: str, nbytes: int) -> None:
+    """Book one explicit host↔device transfer (``direction`` ``"h2d"``
+    or ``"d2h"``) in the ``device.bytes_*`` counters."""
+    if direction == "h2d":
+        REGISTRY.counter("device.bytes_to_device").inc(int(nbytes))
+    else:
+        REGISTRY.counter("device.bytes_to_host").inc(int(nbytes))
+
+
+_hbm_lock = threading.Lock()
+_hbm_live = 0
+
+
+def track_hbm(nbytes: int) -> int:
+    """Adjust the live-HBM-footprint estimate (negative to release) and
+    observe the ``device.hbm_bytes`` gauge; returns the new estimate.
+    The estimate is array-size arithmetic, not an allocator query — it
+    tracks what the framework *put* on device, which is exactly the
+    number a shard-sizing decision needs."""
+    global _hbm_live
+    with _hbm_lock:
+        _hbm_live = max(0, _hbm_live + int(nbytes))
+        live = _hbm_live
+    REGISTRY.gauge("device.hbm_bytes").observe(live)
+    return live
+
+
+def hbm_live_bytes() -> int:
+    with _hbm_lock:
+        return _hbm_live
+
+
+@contextlib.contextmanager
+def hbm_resident(nbytes: int) -> Iterator[None]:
+    """Scope one call's device residency: adds ``nbytes`` to the live
+    HBM estimate on entry and releases it on exit, so the gauge's max
+    is the peak concurrent footprint across overlapping device calls."""
+    track_hbm(nbytes)
+    try:
+        yield
+    finally:
+        track_hbm(-nbytes)
+
+
+# ---------------------------------------------------------------------------
 # Chrome/Perfetto trace_event export
 # ---------------------------------------------------------------------------
+
+
+_DEVICE_TRACK_PID = 2  # device.* spans render as their own process row
 
 
 def chrome_trace_events(
@@ -656,8 +792,12 @@ def chrome_trace_events(
     """Spans as Chrome ``trace_event`` complete events (``ph: "X"``,
     microsecond units).  Rows (``tid``) are shard ids when the span
     carries one, so chrome://tracing / Perfetto renders the per-shard
-    waterfall directly."""
+    waterfall directly.  ``device.*`` spans land on their own track
+    (process row 2, named via metadata events), so kernel/transfer
+    time reads against the host stages instead of hiding inside one
+    shard's row."""
     events = []
+    has_device = False
     for s in (spans() if span_list is None else span_list):
         labels = s.get("labels") or {}
         tid = labels.get("shard")
@@ -665,15 +805,24 @@ def chrome_trace_events(
             tid = int(tid)
         except (TypeError, ValueError):
             tid = 0
+        device = s["name"].startswith("device.")
+        has_device = has_device or device
         events.append({
             "name": s["name"],
             "ph": "X",
             "ts": round(s["ts"] * 1e6, 3),
             "dur": round(s["dur"] * 1e6, 3),
-            "pid": 1,
+            "pid": _DEVICE_TRACK_PID if device else 1,
             "tid": tid,
             "args": labels,
         })
+    if has_device:
+        events = [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "host"}},
+            {"name": "process_name", "ph": "M", "pid": _DEVICE_TRACK_PID,
+             "args": {"name": "device"}},
+        ] + events
     return events
 
 
@@ -824,7 +973,10 @@ def reset_gauges() -> None:
 
 
 def reset_telemetry() -> None:
-    """Zero everything: registry, span ring (the JSONL sink, if open,
-    is left open — it is an append log)."""
+    """Zero everything: registry, span ring, the live-HBM estimate
+    (the JSONL sink, if open, is left open — it is an append log)."""
+    global _hbm_live
     REGISTRY.reset()
     reset_spans()
+    with _hbm_lock:
+        _hbm_live = 0

@@ -43,6 +43,10 @@ ALLOWED_PREFIXES = {
     # Live introspection (runtime/introspect.py): heartbeat-watchdog
     # stall events and the /progress feed.
     "watchdog", "progress",
+    # Device observability (runtime/device_pipeline.py + ops/): synced
+    # kernel spans, transfer counters, HBM gauge; and the cluster
+    # aggregator's scrape telemetry (runtime/cluster.py).
+    "device", "cluster",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
@@ -51,6 +55,7 @@ NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 # a leading underscore, e.g. http.py's ``_span`` / ``_counter``).
 CALL_RE = re.compile(
     r"""\b_?(span|wrap_span|trace_phase|record_phase|record_span|
+             device_span|synced_timer|
              counter|gauge|histogram|observe_gauge)\s*\(\s*
         (["'])([^"'\n]+)\2""",
     re.VERBOSE,
@@ -66,6 +71,8 @@ KIND_OF = {
     "trace_phase": "timing",
     "record_phase": "timing",
     "record_span": "timing",
+    "device_span": "timing",
+    "synced_timer": "timing",
     "histogram": "timing",
 }
 
@@ -108,6 +115,37 @@ def scan_readme() -> Set[str]:
     }
 
 
+# README "kind" column text -> the canonical kind the code scan uses.
+_DOC_KIND = {
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "timing",
+    "span": "timing",
+    "span/histogram": "timing",
+}
+
+_ROW_RE = re.compile(
+    r"^\|\s*`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`\s*\|\s*([^|]+?)\s*\|")
+
+
+def scan_readme_kinds() -> Dict[str, str]:
+    """{name: kind-column text} for every standard metric-table row —
+    the second drift axis: a metric documented as the wrong *kind* is
+    as misleading as an undocumented one."""
+    with open(README) as f:
+        text = f.read()
+    try:
+        block = text.split(MARK_BEGIN, 1)[1].split(MARK_END, 1)[0]
+    except IndexError:
+        return {}
+    out: Dict[str, str] = {}
+    for line in block.splitlines():
+        m = _ROW_RE.match(line.strip())
+        if m:
+            out[m.group(1)] = m.group(2).strip()
+    return out
+
+
 def main() -> int:
     kinds, sites = scan_code()
     errors: List[str] = []
@@ -143,6 +181,17 @@ def main() -> int:
             errors.append(
                 f"{name!r}: documented in README but not found in code "
                 "(stale doc, or the name drifted)")
+        doc_kinds = scan_readme_kinds()
+        for name in sorted(code_names & set(doc_kinds)):
+            if len(kinds[name]) != 1:
+                continue  # kind conflict already reported above
+            doc_kind = _DOC_KIND.get(doc_kinds[name].lower())
+            code_kind = next(iter(kinds[name]))
+            if doc_kind is not None and doc_kind != code_kind:
+                errors.append(
+                    f"{name!r}: README documents kind "
+                    f"{doc_kinds[name]!r} but code registers "
+                    f"{code_kind!r} ({', '.join(sites[name][:2])})")
 
     if errors:
         print(f"check_metrics: {len(errors)} problem(s)")

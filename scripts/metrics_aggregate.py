@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""metrics_aggregate — cluster rollup of N introspection endpoints.
+
+Each process of a multi-process run serves its own
+``/metrics``/``/progress``/``/healthz`` (``runtime/introspect.py``,
+``DISQ_TPU_INTROSPECT_PORT``); this CLI fronts them with ONE endpoint
+(``runtime/cluster.py``): every worker series re-labeled
+``process="<id>"``, one rollup series per metric holding the
+cross-process sum, summed per-direction progress with a recomputed
+ETA, and a cluster health verdict that names degraded or unreachable
+workers.
+
+Usage::
+
+    # serve the rollup (scrapes on demand, throttled):
+    python scripts/metrics_aggregate.py \
+        --endpoints 10.0.0.1:9100,10.0.0.2:9100 --port 9090
+
+    # one-shot to stdout (scripting / tests):
+    python scripts/metrics_aggregate.py --endpoints ... --once metrics
+    python scripts/metrics_aggregate.py --endpoints ... --once progress
+    python scripts/metrics_aggregate.py --endpoints ... --once healthz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge N disq_tpu introspection endpoints into one "
+                    "cluster /metrics + /progress + /healthz")
+    ap.add_argument(
+        "--endpoints", required=True,
+        help="comma-separated worker endpoints (host:port)")
+    ap.add_argument(
+        "--port", type=int, default=0,
+        help="serve the rollup on 127.0.0.1:PORT (0 = ephemeral; "
+             "ignored with --once)")
+    ap.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-worker scrape timeout, seconds (default 5)")
+    ap.add_argument(
+        "--once", choices=("metrics", "progress", "healthz"),
+        default=None,
+        help="scrape once, print the chosen merged view to stdout, "
+             "exit (nonzero when any worker is unreachable)")
+    args = ap.parse_args(argv)
+
+    from disq_tpu.runtime.cluster import ClusterAggregator
+
+    agg = ClusterAggregator(
+        args.endpoints.split(","), timeout_s=args.timeout)
+    if args.once:
+        workers = agg.scrape()
+        if args.once == "metrics":
+            sys.stdout.write(agg.metrics_text(workers))
+        elif args.once == "progress":
+            json.dump(agg.progress(workers), sys.stdout, indent=2,
+                      default=str)
+            sys.stdout.write("\n")
+        else:
+            json.dump(agg.healthz(workers), sys.stdout, indent=2,
+                      default=str)
+            sys.stdout.write("\n")
+        return 0 if all(w.ok for w in workers) else 1
+
+    addr = agg.serve(args.port)
+    print(f"cluster rollup at http://{addr} "
+          f"(/metrics /progress /healthz) over "
+          f"{len(agg.endpoints)} workers", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agg.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

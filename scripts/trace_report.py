@@ -23,14 +23,25 @@ when a meta line records nonzero ``dropped_spans`` (the in-memory span
 ring overflowed), a warning banner flags that ring-derived timelines
 are truncated.
 
+Device spans (``device.kernel`` / ``device.transfer`` — the synced
+kernel timings from ``runtime/device_pipeline.py`` and the ``ops/``
+wrappers) categorize as ``K``/``T``.
+
 Usage::
 
     python scripts/trace_report.py spans.jsonl [--top 5] [--width 80]
         [--run RUN_ID] [--chrome out.json]
+    python scripts/trace_report.py spans.jsonl --analyze
     python scripts/trace_report.py progress.jsonl --progress
 
 ``--chrome`` additionally converts the spans to Chrome/Perfetto
-``trace_event`` JSON (open in chrome://tracing or ui.perfetto.dev).
+``trace_event`` JSON (open in chrome://tracing or ui.perfetto.dev;
+device spans render on their own process track).
+``--analyze`` is the "why is this run slow" mode: a time-sweep
+attributes every instant of wall-clock to one bucket (stage / device /
+transfer / stall / idle), a backward walk extracts the critical path
+through the per-shard fetch→decode→emit chains and device spans, and
+a one-line verdict names the bottleneck with the knob that moves it.
 ``--progress`` instead replays a progress JSONL
 (``DisqOptions.progress_log``) into a per-direction
 throughput-over-time ASCII sparkline.
@@ -59,6 +70,10 @@ CATEGORIES = (
     ("stage", "S", ("bam.write.stage", "vcf.write.stage",
                     "bcf.write.stage", "cram.write.stage",
                     "sam.write.stage")),
+    # Device-pipeline spans (runtime/device_pipeline.py + ops/): synced
+    # kernel execution and explicit h2d/d2h transfer phases.
+    ("device", "K", ("device.kernel",)),
+    ("transfer", "T", ("device.transfer",)),
     ("emit_stall", "s", ("executor.emit.stall", "writer.emit.stall")),
     ("retry", "r", ("retry.",)),
     ("quarantine", "q", ("quarantine.",)),
@@ -258,6 +273,210 @@ def report(spans, run, runs, top: int, width: int,
 
 
 # ---------------------------------------------------------------------------
+# --analyze: critical path + wall-clock attribution + bottleneck verdict
+# ---------------------------------------------------------------------------
+
+# Stall-ish categories merge into one "stall" bucket for attribution;
+# everything else keeps its stage name, plus "idle" for uninstrumented
+# wall-clock.
+STALL_CATEGORIES = {"emit_stall", "retry", "quarantine", "watchdog"}
+
+# Tie-break priority when several work buckets are live in the same
+# instant: the most downstream/specific work wins (a device kernel
+# running concurrently with a host fetch means the run is device-side
+# at that instant).
+WORK_PRIORITY = ("device", "transfer", "decode", "encode", "deflate",
+                 "stage", "fetch")
+
+ADVICE = {
+    "fetch": "I/O-bound range reads: raise executor_workers / "
+             "prefetch_shards, or move the input closer",
+    "decode": "CPU-bound record decode: raise executor_workers or "
+              "enable the device codec",
+    "encode": "CPU-bound record encode: raise writer_workers",
+    "deflate": "CPU-bound compression: raise writer_workers (the "
+               "native codec already threads within a shard)",
+    "stage": "staging-latency-bound writes: raise writer_workers / "
+             "writer_prefetch_shards",
+    "device": "device-bound: kernel time dominates; grow per-launch "
+              "batches or add chips",
+    "transfer": "transfer-bound: host<->device copies dominate; keep "
+                "shards device-resident between stages",
+    "stall": "serialization-bound: ordered-emit / retry stalls "
+             "dominate; raise prefetch_shards",
+    "idle": "pipeline starved: wall-clock outside instrumented stages "
+            "(driver-side gaps between runs)",
+}
+
+
+def bucket_of(name: str) -> Optional[str]:
+    cat = category_of(name)
+    if cat is None:
+        return None
+    return "stall" if cat in STALL_CATEGORIES else cat
+
+
+def attribute_wall(spans) -> "tuple[dict, float, float, float]":
+    """Time-sweep wall-clock attribution: the run window [t0, t1] is
+    split at every span boundary and each elementary interval is
+    attributed to exactly ONE bucket — a live work bucket beats the
+    stall bucket (work anywhere means the run is progressing), the
+    busiest work bucket wins the interval, ties break by
+    ``WORK_PRIORITY``; intervals with no categorized span live are
+    ``idle``.  Returns ({bucket: seconds}, t0, t1, wall)."""
+    events = []  # (time, delta, bucket)
+    for s in spans:
+        b = bucket_of(s["name"])
+        if b is None or s["dur"] <= 0:
+            continue
+        events.append((s["ts"], 1, b))
+        events.append((s["ts"] + s["dur"], -1, b))
+    if not events:
+        return {}, 0.0, 0.0, 0.0
+    events.sort(key=lambda e: (e[0], -e[1]))
+    t0 = events[0][0]
+    t1 = max(e[0] for e in events)
+    live: Dict[str, int] = defaultdict(int)
+    out: Dict[str, float] = defaultdict(float)
+    prev = t0
+    i = 0
+    rank = {b: i for i, b in enumerate(WORK_PRIORITY)}
+    while i < len(events):
+        t = events[i][0]
+        if t > prev:
+            work = [(b, n) for b, n in live.items()
+                    if n > 0 and b != "stall"]
+            if work:
+                winner = min(work,
+                             key=lambda bn: (-bn[1],
+                                             rank.get(bn[0], 99)))[0]
+            elif live.get("stall", 0) > 0:
+                winner = "stall"
+            else:
+                winner = "idle"
+            out[winner] += t - prev
+            prev = t
+        while i < len(events) and events[i][0] == t:
+            live[events[i][2]] += events[i][1]
+            i += 1
+    return dict(out), t0, t1, t1 - t0
+
+
+def critical_path(spans, max_segments: int = 512):
+    """Backward walk from the end of the run: at each point pick the
+    *innermost* (latest-starting) span covering it, jump to that
+    span's start, and bridge uncovered gaps as ``idle`` — the chain of
+    spans that actually determined the makespan.  Returns
+    ``[(label, bucket, seconds), ...]`` in forward order."""
+    import bisect
+
+    items = []
+    for s in spans:
+        b = bucket_of(s["name"])
+        if b is None or s["dur"] <= 0:
+            continue
+        items.append((s["ts"], s["ts"] + s["dur"], s, b))
+    if not items:
+        return []
+    # Descending start time: the walk wants the LATEST-starting span
+    # covering t, so a bisect into this order plus a forward scan that
+    # stops at the first still-open span replaces the old full rescan
+    # per segment (quadratic on big logs).
+    items.sort(key=lambda i: -i[0])
+    neg_starts = [-i[0] for i in items]      # ascending, for bisect
+    sorted_ends = sorted(i[1] for i in items)  # for gap jumps
+    eps = 1e-9
+    t0 = items[-1][0]
+    t = sorted_ends[-1]
+    path = []
+    while t > t0 + eps and len(path) < max_segments:
+        # candidates: ts < t - eps  <=>  -ts > -(t - eps)
+        idx = bisect.bisect_right(neg_starts, -(t - eps))
+        winner = None
+        for i in range(idx, len(items)):
+            if items[i][1] >= t - eps:
+                winner = items[i]
+                break
+        if winner is not None:
+            ts, te, s, b = winner
+            labels = s.get("labels") or {}
+            if "shard" in labels:
+                label = f"{b}[shard {labels['shard']}]"
+            elif "kernel" in labels:
+                label = f"{b}[{labels['kernel']}]"
+            else:
+                label = b
+            path.append((label, b, min(te, t) - ts))
+            t = ts
+        else:
+            # uncovered gap: jump to the latest span end before t
+            j = bisect.bisect_left(sorted_ends, t - eps)
+            if j == 0:
+                break
+            te = sorted_ends[j - 1]
+            path.append(("idle", "idle", t - te))
+            t = te
+    path.reverse()
+    return path
+
+
+def analyze(spans, run, runs, dropped: int = 0) -> str:
+    """The "why is this run slow" report: wall-clock attribution by
+    bucket, the critical path, and a one-line bottleneck verdict."""
+    if not spans:
+        return "no spans found (empty or filtered-out trace)\n"
+    buckets, _t0, _t1, wall = attribute_wall(spans)
+    if not buckets or wall <= 0:
+        return ("no categorized spans found (nothing to attribute)\n")
+    out: List[str] = []
+    out.append(f"run {run}  ({len(spans)} spans, wall {wall:.3f}s"
+               + (f"; file holds runs: {', '.join(runs)}"
+                  if len(runs) > 1 else "") + ")")
+    if dropped:
+        out.append(
+            f"WARNING: span ring overflowed ({dropped} spans dropped "
+            "from the in-memory ring) — attribution, critical path "
+            "and verdict are computed from a truncated timeline")
+    out.append("")
+    out.append("wall-clock attribution")
+    order = sorted(buckets, key=lambda b: -buckets[b])
+    name_w = max(len(b) for b in order)
+    for b in order:
+        v = buckets[b]
+        out.append(f"  {b:<{name_w}}  {fmt_s(v)}  "
+                   f"{v / wall * 100:5.1f}%")
+    out.append("")
+
+    path = critical_path(spans)
+    if path:
+        out.append(f"critical path ({len(path)} segments)")
+        shown = path if len(path) <= 12 else (
+            path[:6] + [("...", None, None)] + path[-5:])
+        parts = [
+            lbl if dur is None else f"{lbl} {fmt_s(dur).strip()}"
+            for lbl, _b, dur in shown
+        ]
+        # wrap at ~72 cols for readability
+        line = "  "
+        for j, part in enumerate(parts):
+            token = part + (" -> " if j < len(parts) - 1 else "")
+            if len(line) + len(token) > 74 and line.strip():
+                out.append(line.rstrip())
+                line = "    "
+            line += token
+        if line.strip():
+            out.append(line.rstrip())
+        out.append("")
+
+    top = order[0]
+    out.append(
+        f"verdict: {top} is the bottleneck — "
+        f"{buckets[top] / wall * 100:.1f}% of wall-clock")
+    out.append(f"  ({ADVICE.get(top, 'no advice for this bucket')})")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # --progress: replay a progress JSONL (DisqOptions.progress_log) into a
 # throughput-over-time sparkline
 # ---------------------------------------------------------------------------
@@ -381,11 +600,21 @@ def main(argv=None) -> int:
                     help="treat the input as a progress JSONL "
                     "(DisqOptions.progress_log) and replay it as a "
                     "throughput-over-time sparkline")
+    ap.add_argument("--analyze", action="store_true",
+                    help="critical-path analysis instead of the "
+                    "waterfall: wall-clock attribution by "
+                    "stage/stall/transfer bucket and a one-line "
+                    "bottleneck verdict")
     args = ap.parse_args(argv)
 
     if args.progress:
         recs, run, runs = load_progress(args.jsonl, args.run)
         sys.stdout.write(progress_report(recs, run, runs, args.width))
+        return 0
+
+    if args.analyze:
+        spans, run, runs, dropped = load_spans(args.jsonl, args.run)
+        sys.stdout.write(analyze(spans, run, runs, dropped))
         return 0
 
     spans, run, runs, dropped = load_spans(args.jsonl, args.run)

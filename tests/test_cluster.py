@@ -1,0 +1,339 @@
+"""Cluster aggregation (``runtime/cluster.py`` +
+``scripts/metrics_aggregate.py``): exposition parsing, the
+process-labeled merge with sum rollups, merged progress/health views,
+and the end-to-end acceptance — ≥2 subprocess workers with distinct
+``process`` labels whose rollup totals equal the per-process sums."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from disq_tpu.runtime.cluster import (
+    ClusterAggregator,
+    WorkerState,
+    parse_metrics_text,
+)
+from disq_tpu.runtime.tracing import reset_telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    reset_telemetry()
+    yield
+    reset_telemetry()
+
+
+# -- exposition parsing -----------------------------------------------------
+
+
+EXPO = """\
+# TYPE disq_tpu_process_info gauge
+disq_tpu_process_info{process_id="2",run_id="r2"} 1
+# TYPE disq_tpu_progress_records counter
+disq_tpu_progress_records 1200
+# TYPE disq_tpu_retry_attempts counter
+disq_tpu_retry_attempts{what="shard.fetch"} 3
+# TYPE disq_tpu_executor_fetch_seconds histogram
+disq_tpu_executor_fetch_seconds_bucket{shard="0",le="0.005"} 2
+disq_tpu_executor_fetch_seconds_bucket{shard="0",le="+Inf"} 2
+disq_tpu_executor_fetch_seconds_sum{shard="0"} 0.004
+disq_tpu_executor_fetch_seconds_count{shard="0"} 2
+"""
+
+
+class TestParseMetricsText:
+    def test_kinds_and_samples(self):
+        kinds, samples = parse_metrics_text(EXPO)
+        assert kinds["disq_tpu_progress_records"] == "counter"
+        assert kinds["disq_tpu_executor_fetch_seconds"] == "histogram"
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["disq_tpu_progress_records"] == [((), 1200.0)]
+        assert by_name["disq_tpu_retry_attempts"] == [
+            ((("what", "shard.fetch"),), 3.0)]
+        buckets = by_name["disq_tpu_executor_fetch_seconds_bucket"]
+        assert ((("le", "+Inf"), ("shard", "0")), 2.0) in [
+            (tuple(sorted(ls)), v) for ls, v in buckets]
+
+    def test_garbage_lines_skipped(self):
+        kinds, samples = parse_metrics_text(
+            "not a sample\n# random comment\nname_only\n")
+        assert kinds == {} and samples == []
+
+
+# -- merge over hand-built workers ------------------------------------------
+
+
+def _fake_worker(pid, records, retries, endpoint="w"):
+    w = WorkerState(f"{endpoint}{pid}")
+    w.ok = True
+    w.process_id = pid
+    w.kinds, w.samples = parse_metrics_text(
+        "# TYPE disq_tpu_progress_records counter\n"
+        f"disq_tpu_progress_records {records}\n"
+        "# TYPE disq_tpu_retry_attempts counter\n"
+        f'disq_tpu_retry_attempts{{what="x"}} {retries}\n')
+    w.progress = {
+        "run_id": f"run{pid}", "process_id": pid,
+        "directions": {"read": {
+            "active": False, "shards_total": 4, "shards_done": 4,
+            "in_flight": 0, "records": records, "bytes_compressed": 10,
+            "bytes_uncompressed": 30, "records_per_sec": 100.0,
+            "shards_per_sec": 2.0, "elapsed_s": 1.5, "eta_s": 0.0,
+        }},
+    }
+    w.healthz = {"status": "ok"}
+    return w
+
+
+class TestMergedViews:
+    def _agg(self):
+        return ClusterAggregator(["w0:1", "w1:1"])
+
+    def test_metrics_rollup_equals_per_process_sum(self):
+        workers = [_fake_worker(0, 700, 1), _fake_worker(1, 500, 2)]
+        text = self._agg().metrics_text(workers)
+        _kinds, samples = parse_metrics_text(text)
+        recs = {labels: v for name, labels, v in samples
+                if name == "disq_tpu_progress_records"}
+        assert recs[(("process", "0"),)] == 700.0
+        assert recs[(("process", "1"),)] == 500.0
+        assert recs[()] == 1200.0  # the rollup series
+        retries = {labels: v for name, labels, v in samples
+                   if name == "disq_tpu_retry_attempts"}
+        assert retries[(("what", "x"),)] == 3.0
+        assert "# TYPE disq_tpu_progress_records counter" in text
+        assert 'disq_tpu_cluster_workers{state="ok"} 2' in text
+
+    def test_progress_sums_directions_and_keeps_processes(self):
+        workers = [_fake_worker(0, 700, 1), _fake_worker(1, 500, 2)]
+        doc = self._agg().progress(workers)
+        read = doc["directions"]["read"]
+        assert read["shards_total"] == 8 and read["shards_done"] == 8
+        assert read["records"] == 1200
+        assert read["records_per_sec"] == 200.0
+        assert read["eta_s"] == 0.0
+        assert set(doc["processes"]) == {"0", "1"}
+        assert doc["workers_ok"] == 2
+
+    def test_progress_eta_recomputed_from_cluster_rate(self):
+        w0, w1 = _fake_worker(0, 700, 1), _fake_worker(1, 500, 2)
+        for w in (w0, w1):
+            view = w.progress["directions"]["read"]
+            view["active"] = True
+            view["shards_done"] = 2
+        doc = self._agg().progress([w0, w1])
+        read = doc["directions"]["read"]
+        # 4 shards remain at 4 shards/sec summed
+        assert read["eta_s"] == pytest.approx(1.0)
+
+    def test_healthz_degrades_on_unreachable_and_degraded(self):
+        ok = _fake_worker(0, 1, 0)
+        degraded = _fake_worker(1, 1, 0)
+        degraded.healthz = {"status": "degraded", "stalls": [{"shard": 3}]}
+        dead = WorkerState("w2:1")
+        dead.ok = False
+        dead.error = "ConnectionRefusedError: x"
+        doc = self._agg().healthz([ok, degraded, dead])
+        assert doc["status"] == "degraded"
+        statuses = {p["status"] for p in doc["problems"]}
+        assert statuses == {"degraded", "unreachable"}
+        assert self._agg().healthz([ok])["status"] == "ok"
+
+    def test_requires_endpoints(self):
+        with pytest.raises(ValueError):
+            ClusterAggregator([])
+
+
+# -- end-to-end: subprocess workers -----------------------------------------
+
+
+WORKER_CODE = """\
+import sys
+sys.path.insert(0, {repo!r})
+from disq_tpu.runtime.introspect import HEALTH, start_introspect_server
+from disq_tpu.runtime.tracing import counter
+
+records = int(sys.argv[1])
+counter("retry.attempts").inc(int(sys.argv[2]), what="bench")
+tok = HEALTH.register_run("read", 4)
+for s in range(4):
+    HEALTH.beat(tok, "fetch", s)
+    HEALTH.shard_done(tok, s)
+HEALTH.note_counters("read", records=records, bytes_compressed=records)
+HEALTH.finish_run(tok)
+addr = start_introspect_server(0)
+print("ADDR", addr, flush=True)
+sys.stdin.readline()  # hold the endpoint open until the parent is done
+"""
+
+
+@pytest.fixture()
+def two_workers():
+    """Two live introspection endpoints in subprocesses with distinct
+    DISQ_TPU_PROCESS_ID and known counter values."""
+    procs, addrs = [], []
+    code = WORKER_CODE.format(repo=REPO)
+    try:
+        for pid, (records, retries) in enumerate(((800, 2), (300, 5))):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       DISQ_TPU_PROCESS_ID=str(pid),
+                       DISQ_TPU_PROCESS_COUNT="2")
+            p = subprocess.Popen(
+                [sys.executable, "-c", code, str(records), str(retries)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True, env=env, cwd=REPO)
+            procs.append(p)
+        for p in procs:
+            line = p.stdout.readline()
+            assert line.startswith("ADDR "), line
+            addrs.append(line.split()[1])
+        yield procs, addrs
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+class TestEndToEnd:
+    def test_aggregates_two_workers_with_distinct_labels(self, two_workers):
+        """Acceptance: ≥2 subprocess workers merged with distinct
+        ``process`` labels; rollup totals equal per-process sums."""
+        _procs, addrs = two_workers
+        agg = ClusterAggregator(addrs, timeout_s=10)
+        workers = agg.scrape()
+        assert all(w.ok for w in workers)
+        assert sorted(w.process_id for w in workers) == [0, 1]
+
+        text = agg.metrics_text(workers)
+        _kinds, samples = parse_metrics_text(text)
+        recs = {labels: v for name, labels, v in samples
+                if name == "disq_tpu_progress_records"}
+        assert recs[(("process", "0"),)] == 800.0
+        assert recs[(("process", "1"),)] == 300.0
+        assert recs[()] == 1100.0
+        shards = {labels: v for name, labels, v in samples
+                  if name == "disq_tpu_progress_shards"}
+        assert shards[(("direction", "read"),)] == 8.0
+        retries = {labels: v for name, labels, v in samples
+                   if name == "disq_tpu_retry_attempts"}
+        assert retries[(("process", "0"), ("what", "bench"))] == 2.0
+        assert retries[(("process", "1"), ("what", "bench"))] == 5.0
+        assert retries[(("what", "bench"),)] == 7.0
+
+        prog = agg.progress(workers)
+        read = prog["directions"]["read"]
+        assert read["shards_total"] == 8 and read["shards_done"] == 8
+        assert read["records"] == 1100
+        assert agg.healthz(workers)["status"] == "ok"
+
+    def test_served_rollup_endpoint(self, two_workers):
+        _procs, addrs = two_workers
+        agg = ClusterAggregator(addrs, timeout_s=10)
+        addr = agg.serve(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=10) as resp:
+                text = resp.read().decode()
+            assert 'disq_tpu_progress_records{process="0"} 800' in text
+            assert 'disq_tpu_cluster_workers{state="ok"} 2' in text
+            with urllib.request.urlopen(
+                    f"http://{addr}/progress", timeout=10) as resp:
+                doc = json.loads(resp.read())
+            assert doc["directions"]["read"]["records"] == 1100
+            with urllib.request.urlopen(
+                    f"http://{addr}/healthz", timeout=10) as resp:
+                assert json.loads(resp.read())["status"] == "ok"
+        finally:
+            agg.close()
+
+    def test_dead_worker_degrades_cluster_health(self, two_workers):
+        procs, addrs = two_workers
+        procs[1].kill()
+        procs[1].wait(timeout=10)
+        deadline = time.time() + 10
+        agg = ClusterAggregator(addrs, timeout_s=3,
+                                min_scrape_interval_s=0.0)
+        while time.time() < deadline:
+            doc = agg.healthz(agg.scrape())
+            if doc["status"] == "degraded":
+                break
+            time.sleep(0.2)
+        assert doc["status"] == "degraded"
+        assert any(p["status"] == "unreachable" for p in doc["problems"])
+        assert doc["workers_ok"] == 1
+
+    def test_duplicate_reported_ids_get_unique_labels(self):
+        """N workers all reporting process_id 0 (the un-overridden
+        jax.process_index() case) must still merge with UNIQUE process
+        labels and rollup == sum — not overwrite each other."""
+        procs, addrs = [], []
+        code = WORKER_CODE.format(repo=REPO)
+        try:
+            for records in (600, 400):
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           DISQ_TPU_PROCESS_ID="0")  # both claim id 0
+                p = subprocess.Popen(
+                    [sys.executable, "-c", code, str(records), "1"],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True, env=env, cwd=REPO)
+                procs.append(p)
+            for p in procs:
+                line = p.stdout.readline()
+                assert line.startswith("ADDR "), line
+                addrs.append(line.split()[1])
+            agg = ClusterAggregator(addrs, timeout_s=10)
+            workers = agg.scrape()
+            assert sorted(w.process_id for w in workers) == [0, 1]
+            _k, samples = parse_metrics_text(agg.metrics_text(workers))
+            recs = {labels: v for name, labels, v in samples
+                    if name == "disq_tpu_progress_records"}
+            assert sorted(v for ls, v in recs.items() if ls) == [
+                400.0, 600.0]
+            assert recs[()] == 1000.0
+            prog = agg.progress(workers)
+            assert set(prog["processes"]) == {"0", "1"}
+        finally:
+            for p in procs:
+                try:
+                    p.stdin.close()
+                except OSError:
+                    pass
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    def test_metrics_aggregate_cli_once(self, two_workers):
+        _procs, addrs = two_workers
+        script = os.path.join(REPO, "scripts", "metrics_aggregate.py")
+        proc = subprocess.run(
+            [sys.executable, script, "--endpoints", ",".join(addrs),
+             "--once", "progress"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["directions"]["read"]["records"] == 1100
+        assert doc["workers_ok"] == 2
+
+        proc = subprocess.run(
+            [sys.executable, script, "--endpoints", ",".join(addrs),
+             "--once", "metrics"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert 'disq_tpu_progress_records{process="1"} 300' in proc.stdout

@@ -7,13 +7,27 @@ one process, and a psum over both mesh axes runs on the virtual
 executes.
 """
 
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
 
-from disq_tpu.runtime.multihost import global_mesh, initialize, plan_axes
+from disq_tpu.runtime.multihost import (
+    global_mesh,
+    initialize,
+    plan_axes,
+    process_count,
+    process_id,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class TestPlanAxes:
@@ -28,6 +42,83 @@ class TestPlanAxes:
         with pytest.raises(ValueError):
             plan_axes(8, 0)
 
+    def test_rejects_nonpositive(self):
+        with pytest.raises(ValueError, match="n_processes"):
+            plan_axes(8, -1)
+        with pytest.raises(ValueError, match="n_devices_total"):
+            plan_axes(0, 2)
+        with pytest.raises(ValueError, match="n_devices_total"):
+            plan_axes(-8, 2)
+
+
+class TestProcessIdentity:
+    def test_single_process_defaults(self, monkeypatch):
+        monkeypatch.delenv("DISQ_TPU_PROCESS_ID", raising=False)
+        monkeypatch.delenv("DISQ_TPU_PROCESS_COUNT", raising=False)
+        assert process_id() == 0
+        assert process_count() == 1
+
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("DISQ_TPU_PROCESS_ID", "3")
+        monkeypatch.setenv("DISQ_TPU_PROCESS_COUNT", "4")
+        assert process_id() == 3
+        assert process_count() == 4
+
+    def test_garbage_env_falls_through(self, monkeypatch):
+        monkeypatch.setenv("DISQ_TPU_PROCESS_ID", "nope")
+        monkeypatch.setenv("DISQ_TPU_PROCESS_COUNT", "nah")
+        assert process_id() == 0
+        assert process_count() == 1
+
+    def test_introspect_endpoint_labels_process_multiprocess_mode(
+            self, tmp_path):
+        """A worker launched with a distinct DISQ_TPU_PROCESS_ID (the
+        multi-process labeling path, CPU-simulated) serves that id on
+        /metrics (process_info series), /healthz and /progress."""
+        code = (
+            "import sys, json, urllib.request\n"
+            "sys.path.insert(0, %r)\n"
+            "from disq_tpu.runtime.introspect import "
+            "start_introspect_server\n"
+            "addr = start_introspect_server(0)\n"
+            "m = urllib.request.urlopen("
+            "'http://%%s/metrics' %% addr, timeout=10).read().decode()\n"
+            "h = json.load(urllib.request.urlopen("
+            "'http://%%s/healthz' %% addr, timeout=10))\n"
+            "p = json.load(urllib.request.urlopen("
+            "'http://%%s/progress' %% addr, timeout=10))\n"
+            "print(json.dumps({'info': 'process_id=\"5\"' in m,"
+            " 'healthz': h.get('process_id'),"
+            " 'progress': p.get('process_id')}))\n" % REPO)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   DISQ_TPU_PROCESS_ID="5")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, env=env,
+                              cwd=REPO, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert doc == {"info": True, "healthz": 5, "progress": 5}
+
+    def test_introspect_endpoint_labels_process_single_mode(
+            self, monkeypatch):
+        """Single-process (no env override): the endpoints label
+        process 0 — in-process, against a live ephemeral server."""
+        from disq_tpu.runtime.introspect import (
+            reset_introspection, start_introspect_server)
+
+        monkeypatch.delenv("DISQ_TPU_PROCESS_ID", raising=False)
+        try:
+            addr = start_introspect_server(0)
+            text = urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=10).read().decode()
+            assert 'disq_tpu_process_info{process_id="0"' in text
+            doc = json.load(urllib.request.urlopen(
+                f"http://{addr}/healthz", timeout=10))
+            assert doc["process_id"] == 0
+        finally:
+            reset_introspection()
+
 
 class TestGlobalMesh:
     def test_single_process_shape(self):
@@ -35,6 +126,22 @@ class TestGlobalMesh:
         assert mesh.shape["dcn"] == 1
         assert mesh.shape["shards"] == len(jax.devices())
         assert set(np.asarray(mesh.devices).ravel()) == set(jax.devices())
+
+    def test_virtual_suite_placement_is_ordinal_sorted(self):
+        """On the 8-virtual-device suite the single host row holds ALL
+        local devices in ascending id order (the explicit
+        (process_index, local ordinal) placement)."""
+        mesh = global_mesh()
+        arr = np.asarray(mesh.devices)
+        assert arr.shape == (1, 8)
+        row = list(arr[0])
+        assert [d.id for d in row] == sorted(d.id for d in jax.devices())
+        assert all(d.process_index == 0 for d in row)
+
+    def test_custom_axis_names(self):
+        mesh = global_mesh(dcn_axis="hosts", ici_axis="local")
+        assert mesh.axis_names == ("hosts", "local")
+        assert mesh.shape["hosts"] == 1
 
     def test_initialize_single_process_noop(self):
         initialize(num_processes=1)  # must not raise or require network

@@ -1,0 +1,25 @@
+"""Tier-1 guard for the telemetry-disabled zero-overhead invariant
+(``scripts/check_overhead.py``): with no observability knob set, the
+pipelines' per-shard hooks must stay behind one ``health is None``
+test, ``note_shard_counters`` behind one boolean, and no thread or
+socket may exist — plus generous absolute per-shard timing budgets so
+accidental O(ms) work on the disabled path fails CI."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_overhead.py")
+
+
+def test_overhead_guard_passes():
+    # fresh subprocess: the structural checks assert on process-global
+    # state (threads, endpoint) that other tests may have touched
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT], capture_output=True, text=True,
+        env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, (
+        f"overhead guard failed:\n{proc.stdout}{proc.stderr}")
+    assert "OK" in proc.stdout

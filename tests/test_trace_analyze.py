@@ -1,0 +1,191 @@
+"""Run analyzer (``scripts/trace_report.py --analyze``): wall-clock
+attribution golden, critical-path extraction, bottleneck verdict, and
+the CLI round trip over a recorded JSONL."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import trace_report  # noqa: E402
+
+
+def _span(name, ts, dur, **labels):
+    return {"name": name, "ts": ts, "dur": dur, "run": "r1",
+            "labels": labels}
+
+
+# A synthetic 10-second run with clean numbers:
+#   shard 0: fetch [0,2), decode [2,8)
+#   shard 1: emit stall [8,9), then fetch [9.5,10)
+# -> fetch 2.5s, decode 6s, stall 1s, idle 0.5s over a 10s wall.
+SPANS = [
+    _span("executor.fetch", 0.0, 2.0, shard=0),
+    _span("executor.decode", 2.0, 6.0, shard=0),
+    _span("executor.emit.stall", 8.0, 1.0, shard=1),
+    _span("executor.fetch", 9.5, 0.5, shard=1),
+]
+
+
+class TestAttribution:
+    def test_bucket_seconds_golden(self):
+        buckets, t0, t1, wall = trace_report.attribute_wall(SPANS)
+        assert (t0, t1, wall) == (0.0, 10.0, 10.0)
+        assert buckets == {
+            "fetch": pytest.approx(2.5),
+            "decode": pytest.approx(6.0),
+            "stall": pytest.approx(1.0),
+            "idle": pytest.approx(0.5),
+        }
+
+    def test_work_beats_stall_and_overlap_attributes_once(self):
+        spans = [
+            _span("executor.fetch", 0.0, 4.0, shard=0),
+            _span("executor.emit.stall", 1.0, 2.0, shard=1),
+            _span("executor.decode", 2.0, 4.0, shard=2),
+        ]
+        buckets, _t0, _t1, wall = trace_report.attribute_wall(spans)
+        assert wall == pytest.approx(6.0)
+        # [0,2) fetch alone (stall overlap loses to work), [2,4) tie
+        # fetch/decode -> WORK_PRIORITY picks decode, [4,6) decode
+        assert buckets == {
+            "fetch": pytest.approx(2.0),
+            "decode": pytest.approx(4.0),
+        }
+
+    def test_device_and_transfer_buckets(self):
+        spans = [
+            _span("device.transfer", 0.0, 1.0, direction="h2d"),
+            _span("device.kernel", 1.0, 3.0, kernel="inflate"),
+            _span("device.transfer", 4.0, 0.5, direction="d2h"),
+        ]
+        buckets, *_rest, wall = trace_report.attribute_wall(spans)
+        assert wall == pytest.approx(4.5)
+        assert buckets == {
+            "transfer": pytest.approx(1.5),
+            "device": pytest.approx(3.0),
+        }
+
+    def test_empty(self):
+        assert trace_report.attribute_wall([]) == ({}, 0.0, 0.0, 0.0)
+
+
+class TestCriticalPath:
+    def test_backward_walk_golden(self):
+        path = trace_report.critical_path(SPANS)
+        assert [(label, round(dur, 6)) for label, _b, dur in path] == [
+            ("fetch[shard 0]", 2.0),
+            ("decode[shard 0]", 6.0),
+            ("stall[shard 1]", 1.0),
+            ("idle", 0.5),
+            ("fetch[shard 1]", 0.5),
+        ]
+
+    def test_innermost_span_wins(self):
+        # a long fetch covering the whole window with a kernel inside:
+        # the walk descends into the later-starting (inner) span first
+        spans = [
+            _span("executor.fetch", 0.0, 10.0, shard=0),
+            _span("device.kernel", 4.0, 6.0, kernel="parse"),
+        ]
+        path = trace_report.critical_path(spans)
+        assert [(label, dur) for label, _b, dur in path] == [
+            ("fetch[shard 0]", 4.0),
+            ("device[parse]", 6.0),
+        ]
+
+
+class TestVerdict:
+    def test_analyze_report_golden(self):
+        out = trace_report.analyze(SPANS, "r1", ["r1"])
+        assert "run r1  (4 spans, wall 10.000s)" in out
+        assert "wall-clock attribution" in out
+        # ordered by share, exact percentages
+        lines = [ln.strip() for ln in out.splitlines()]
+        assert any(ln.startswith("decode") and "60.0%" in ln
+                   for ln in lines)
+        assert any(ln.startswith("fetch") and "25.0%" in ln
+                   for ln in lines)
+        assert any(ln.startswith("stall") and "10.0%" in ln
+                   for ln in lines)
+        assert any(ln.startswith("idle") and "5.0%" in ln
+                   for ln in lines)
+        assert "critical path (5 segments)" in out
+        assert ("verdict: decode is the bottleneck — 60.0% of "
+                "wall-clock") in out
+        assert "CPU-bound record decode" in out
+
+    def test_no_spans(self):
+        assert "no spans" in trace_report.analyze([], None, [])
+
+    def test_dropped_spans_banner(self):
+        out = trace_report.analyze(SPANS, "r1", ["r1"], dropped=7)
+        assert "WARNING" in out and "7 spans dropped" in out
+        assert "truncated timeline" in out
+        assert "WARNING" not in trace_report.analyze(SPANS, "r1", ["r1"])
+
+
+class TestCli:
+    def _write_jsonl(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        with open(log, "w") as f:
+            f.write(json.dumps({"meta": 1, "run_id": "r1"}) + "\n")
+            for s in SPANS:
+                f.write(json.dumps(s) + "\n")
+        return log
+
+    def test_analyze_cli(self, tmp_path):
+        log = self._write_jsonl(tmp_path)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             str(log), "--analyze"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert ("verdict: decode is the bottleneck — 60.0% of "
+                "wall-clock") in proc.stdout
+        assert "wall-clock attribution" in proc.stdout
+        assert "critical path" in proc.stdout
+
+    def test_analyze_cli_surfaces_ring_overflow(self, tmp_path):
+        log = self._write_jsonl(tmp_path)
+        with open(log, "a") as f:
+            f.write(json.dumps(
+                {"meta": 1, "run_id": "r1", "dropped_spans": 12}) + "\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             str(log), "--analyze"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "WARNING" in proc.stdout
+        assert "12 spans dropped" in proc.stdout
+
+    def test_analyze_real_read_names_a_bottleneck(self, tmp_path):
+        """--analyze over a real framework read's span log ends in a
+        verdict line naming one bucket."""
+        from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+        from disq_tpu.api import ReadsStorage
+        from disq_tpu.runtime.tracing import stop_span_log
+
+        src = tmp_path / "in.bam"
+        src.write_bytes(
+            make_bam_bytes(DEFAULT_REFS, synth_records(2000, seed=4)))
+        log = tmp_path / "real.jsonl"
+        ds = (ReadsStorage.make_default().split_size(64 * 1024)
+              .executor_workers(4).span_log(str(log)).read(str(src)))
+        stop_span_log()
+        assert ds.count() == 2000
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             str(log), "--analyze"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "verdict:" in proc.stdout
+        assert "is the bottleneck" in proc.stdout
