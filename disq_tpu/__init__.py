@@ -43,12 +43,15 @@ from disq_tpu.api import (  # noqa: F401
     StageManifestWriteOption,
 )
 from disq_tpu.runtime import (  # noqa: F401
+    BreakerOpenError,
     ClusterAggregator,
     CorruptBlockError,
+    DeadlineExceededError,
     DisqOptions,
     ErrorPolicy,
     PipelineCounters,
     QuarantineManifest,
+    ReadLedger,
     ShardCounters,
     StageManifest,
     WatchdogStallError,

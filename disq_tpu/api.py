@@ -383,6 +383,56 @@ class ReadsStorage:
         self._options = replace(self._options, progress_log=path)
         return self
 
+    def hedged_fetches(self, quantile: float = 0.95,
+                       min_s: float = 0.05) -> "ReadsStorage":
+        """Arm hedged shard fetches (``runtime/resilience.py``): a
+        fetch outliving the rolling ``quantile`` of this run's fetch
+        latencies (never less than ``min_s``) races a duplicate —
+        first result wins, the loser is cancelled/discarded
+        (``hedge.launched`` / ``hedge.won`` / ``hedge.wasted_bytes``
+        telemetry). Decoded output is byte-identical either way."""
+        self._options = self._options.with_hedging(quantile, min_s)
+        return self
+
+    def shard_deadline(self, deadline_s: float) -> "ReadsStorage":
+        """Give every shard a wall-clock budget with escalation:
+        normal retry while young, forced hedging past half the budget,
+        and ``DeadlineExceededError`` once it is spent — which
+        skip/quarantine policies convert into one quarantined empty
+        shard instead of an aborted run."""
+        self._options = self._options.with_shard_deadline(deadline_s)
+        return self
+
+    def retry_budget(self, tokens: int,
+                     refill_per_success: float = 0.1) -> "ReadsStorage":
+        """Install the process-wide retry token bucket: every
+        ``ShardRetrier`` retry spends a token, every success refills
+        ``refill_per_success`` — a dry bucket denies retries so a
+        fault storm cannot stampede the store (``budget.*`` metrics,
+        fill level on ``/healthz``)."""
+        self._options = self._options.with_retry_budget(
+            tokens, refill_per_success)
+        return self
+
+    def circuit_breaker(self, window: int,
+                        cooldown_s: float = 1.0) -> "ReadsStorage":
+        """Arm the per-filesystem circuit breaker: ``window``
+        consecutive transient failures open it, calls then fail fast
+        with ``BreakerOpenError`` until a successful half-open probe
+        after ``cooldown_s`` recloses it (``breaker.*`` metrics, state
+        on ``/healthz``)."""
+        self._options = self._options.with_breaker(window, cooldown_s)
+        return self
+
+    def read_ledger(self, path: str) -> "ReadsStorage":
+        """Make reads crash-resumable: each decoded shard is spilled
+        under ``path`` as it emits, and a killed process restarted
+        with the same ledger re-runs only unfinished shards
+        (``runtime/manifest.py:ReadLedger`` — the read-side
+        generalization of the write ``StageManifest``)."""
+        self._options = self._options.with_read_ledger(path)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -493,6 +543,36 @@ class VariantsStorage:
         from dataclasses import replace
 
         self._options = replace(self._options, progress_log=path)
+        return self
+
+    def hedged_fetches(self, quantile: float = 0.95,
+                       min_s: float = 0.05) -> "VariantsStorage":
+        """See ``ReadsStorage.hedged_fetches``."""
+        self._options = self._options.with_hedging(quantile, min_s)
+        return self
+
+    def shard_deadline(self, deadline_s: float) -> "VariantsStorage":
+        """See ``ReadsStorage.shard_deadline``."""
+        self._options = self._options.with_shard_deadline(deadline_s)
+        return self
+
+    def retry_budget(self, tokens: int,
+                     refill_per_success: float = 0.1
+                     ) -> "VariantsStorage":
+        """See ``ReadsStorage.retry_budget``."""
+        self._options = self._options.with_retry_budget(
+            tokens, refill_per_success)
+        return self
+
+    def circuit_breaker(self, window: int,
+                        cooldown_s: float = 1.0) -> "VariantsStorage":
+        """See ``ReadsStorage.circuit_breaker``."""
+        self._options = self._options.with_breaker(window, cooldown_s)
+        return self
+
+    def read_ledger(self, path: str) -> "VariantsStorage":
+        """See ``ReadsStorage.read_ledger``."""
+        self._options = self._options.with_read_ledger(path)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

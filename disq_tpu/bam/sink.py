@@ -263,7 +263,9 @@ class BamSink:
                 "bam.write.stage",
                 lambda p: self._stage_shard(
                     fs, temp_dir, k, frag_cache, p), shard=k),
-            retrier=write_retrier_for_storage(self._storage),
+            # temp_dir carries the output's scheme, so the part writes
+            # share the destination filesystem's breaker.
+            retrier=write_retrier_for_storage(self._storage, temp_dir),
             what="bam.part",
         )
 
@@ -322,7 +324,7 @@ class BamSink:
         # Every durable driver write runs under the same transient
         # retry budget the staged parts get (atomic create makes a
         # retried write/concat safe).
-        driver = write_retrier_for_storage(self._storage)
+        driver = write_retrier_for_storage(self._storage, path)
         with trace_phase("bam.write.merge"):
             header_comp = compress_to_bgzf(header.to_bam_bytes(), with_terminator=False)
             header_path = os.path.join(temp_dir, "_header")
@@ -387,7 +389,7 @@ class BamSinkMultiple:
                 deflate=wrap_span("bam.write.deflate", compress_to_bgzf,
                                   shard=k),
                 stage=wrap_span("bam.write.stage", stage, shard=k),
-                retrier=write_retrier_for_storage(self._storage),
+                retrier=write_retrier_for_storage(self._storage, path),
                 what="bam.part",
             )
 

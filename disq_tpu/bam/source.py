@@ -137,11 +137,20 @@ class BamSource:
         import functools
 
         from disq_tpu.runtime import ShardCounters, ShardTask
-        from disq_tpu.runtime.errors import context_for_storage
-        from disq_tpu.runtime.executor import executor_for_storage
+        from disq_tpu.runtime.errors import (
+            DisqOptions,
+            context_for_storage,
+            deadline_fallback_for,
+        )
+        from disq_tpu.runtime.executor import (
+            executor_for_storage,
+            map_ordered_resumable,
+            read_ledger_for_storage,
+        )
 
         if ctx is None:
             ctx = context_for_storage(self._storage, path)
+        opts = getattr(self._storage, "_options", None) or DisqOptions()
         splits = compute_path_splits(fs, path, split_size or self.split_size)
         sbi = ctx.retrier.call(self._try_load_sbi, fs, path, what="sbi")
         boundaries = self._split_boundaries(
@@ -161,12 +170,19 @@ class BamSource:
                     self._decode_fetched, header, ctx=shard_ctx),
                 retrier=shard_ctx.retrier,
                 what=f"shard{i}",
+                # Deadline escalation terminal under skip/quarantine:
+                # an over-budget shard is set aside as one empty batch.
+                deadline_fallback=deadline_fallback_for(
+                    opts, shard_ctx,
+                    lambda: (ReadBatch.empty(), (0, 0, 0))),
             ))
         from disq_tpu.runtime.introspect import note_shard_counters
 
         out = []
         self._last_counters = []
-        for res in executor_for_storage(self._storage).map_ordered(tasks):
+        ledger = read_ledger_for_storage(self._storage, path, len(tasks))
+        for res in map_ordered_resumable(
+                executor_for_storage(self._storage), tasks, ledger):
             batch, stats = res.value
             shard_ctx = shard_ctxs[res.shard_id]
             c = ShardCounters(

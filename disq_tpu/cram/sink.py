@@ -77,7 +77,7 @@ def run_cram_write_stage(storage, fs, batch, bounds, n_shards, ref_fetch,
             shard_id=k,
             encode=wrap_span("cram.write.encode", encode, shard=k),
             stage=wrap_span("cram.write.stage", stage, shard=k),
-            retrier=write_retrier_for_storage(storage),
+            retrier=write_retrier_for_storage(storage, part_path_for(k)),
             what="cram.part",
         )
 
@@ -166,7 +166,7 @@ class CramSink:
             part_paths = [i["part"] for i in infos]
             part_lens = [i["len"] for i in infos]
             frags = [i["crai"] for i in infos]
-            driver = write_retrier_for_storage(self._storage)
+            driver = write_retrier_for_storage(self._storage, path)
             prefix_path = os.path.join(temp_dir, "_prefix")
             driver.call(fs.write_all, prefix_path, prefix,
                         what="cram.merge")

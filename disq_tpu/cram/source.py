@@ -104,9 +104,18 @@ class CramSource:
         import functools
 
         from disq_tpu.runtime import ShardTask
-        from disq_tpu.runtime.executor import executor_for_storage
+        from disq_tpu.runtime.errors import (
+            DisqOptions,
+            deadline_fallback_for,
+        )
+        from disq_tpu.runtime.executor import (
+            executor_for_storage,
+            map_ordered_resumable,
+            read_ledger_for_storage,
+        )
         from disq_tpu.runtime.tracing import wrap_span
 
+        opts = getattr(self._storage, "_options", None) or DisqOptions()
         tasks, shard_ctxs, owned_by_shard = [], [], []
         for i, s in enumerate(compute_path_splits(fs, path, self.split_size)):
             owned = [
@@ -135,12 +144,18 @@ class CramSource:
                     shard=i, containers=len(owned)),
                 retrier=shard_ctx.retrier,
                 what=f"cram-shard{i}",
+                # Over-deadline splits under skip/quarantine are set
+                # aside as zero containers instead of aborting.
+                deadline_fallback=deadline_fallback_for(
+                    opts, shard_ctx, list),
             ))
         from disq_tpu.runtime.introspect import note_shard_counters
 
         batches = []
         shard_counters = []
-        for res in executor_for_storage(self._storage).map_ordered(tasks):
+        ledger = read_ledger_for_storage(self._storage, path, len(tasks))
+        for res in map_ordered_resumable(
+                executor_for_storage(self._storage), tasks, ledger):
             shard_batches = res.value
             shard_ctx = shard_ctxs[res.shard_id]
             owned = owned_by_shard[res.shard_id]

@@ -12,7 +12,14 @@ Fault kinds (``FaultSpec.kind``):
   read; a retry re-executes the read, which may fault again
   independently. The model for 5xx blips / reset connections.
 - ``"stall"``     — sleep ``stall_s`` before serving (latency
-  injection; the read then succeeds). The model for a slow tail.
+  injection; the read then succeeds). The model for a wedged call the
+  watchdog should flag.
+- ``"slow"``      — sleep a *seeded* latency drawn uniformly from
+  ``[0, slow_s)`` before serving. Unlike ``stall``'s fixed wedge, this
+  models a latency distribution (a slow tail) — deterministic per
+  ``(seed, call sequence)``, so hedging and deadline escalation are
+  testable against a reproducible tail. A hedged duplicate is a NEW
+  call and draws its own latency.
 - ``"truncate"``  — serve the read but drop the final
   ``truncate_bytes`` bytes of the result. The model for a connection
   cut mid-body.
@@ -59,19 +66,21 @@ from disq_tpu.runtime.errors import TransientIOError
 class FaultSpec:
     """One scheduled fault. Matching is AND across the set criteria."""
 
-    kind: str                       # transient | stall | truncate | bitflip
+    kind: str                       # transient|stall|slow|truncate|bitflip
     path_substr: str = ""           # match paths containing this
     probability: float = 0.0        # Bernoulli per matching call (seeded)
     call_index: Optional[int] = None  # fire on the Nth matching call (0-based)
     offset: Optional[int] = None    # fire when the read covers this byte
     times: int = -1                 # max fires; -1 = unlimited
     stall_s: float = 0.0            # kind="stall"
+    slow_s: float = 0.0             # kind="slow": max seeded latency
     truncate_bytes: int = 1         # kind="truncate": bytes dropped from tail
     bit: int = 0                    # kind="bitflip": bit index 0..7
     op: str = "read"                # direction: "read" | "write"
 
     def __post_init__(self) -> None:
-        if self.kind not in ("transient", "stall", "truncate", "bitflip"):
+        if self.kind not in ("transient", "stall", "slow", "truncate",
+                             "bitflip"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.op not in ("read", "write"):
             raise ValueError(f"unknown fault op {self.op!r}")
@@ -170,7 +179,7 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
         pre-commit for writes — the staged bytes are damaged before
         they land)."""
         for i, spec in enumerate(self.faults):
-            pre = spec.kind in ("transient", "stall")
+            pre = spec.kind in ("transient", "stall", "slow")
             if pre != (data is None):
                 continue
             if not self._spec_matches(i, spec, path, start, length, op):
@@ -186,6 +195,11 @@ class FaultInjectingFileSystemWrapper(FileSystemWrapper):
                 )
             if spec.kind == "stall":
                 self._pending_stall += spec.stall_s
+            elif spec.kind == "slow":
+                # Seeded tail latency: the draw consumes the schedule
+                # RNG under the mutex, so the whole latency sequence is
+                # a pure function of (seed, call sequence).
+                self._pending_stall += self._rng.uniform(0.0, spec.slow_s)
             elif spec.kind == "truncate" and data:
                 data = data[: max(0, len(data) - spec.truncate_bytes)]
             elif spec.kind == "bitflip" and data:

@@ -130,10 +130,20 @@ class HttpFileSystemWrapper(FileSystemWrapper):
         definition of "transient", shared by ranged GETs and HEADs.
         Client errors (4xx) raise immediately; 5xx, network errors and
         stalls back off and retry; the last transient error surfaces
-        once the budget is spent."""
-        from disq_tpu.runtime.errors import ShardRetrier
+        once the budget is spent.
 
-        retrier = ShardRetrier(self._RETRIES, self._BACKOFF_S)
+        When the resilience layer has breakers armed
+        (``DisqOptions.breaker_window``), every HTTP request is gated
+        by the ``http`` filesystem's circuit breaker: a fault storm
+        trips it, and subsequent requests fail fast with
+        ``BreakerOpenError`` instead of stacking timeouts.  Each retry
+        also draws from the shared retry budget (both through the
+        retrier — no breaker configured means no extra work here)."""
+        from disq_tpu.runtime.errors import ShardRetrier
+        from disq_tpu.runtime.resilience import breaker_for
+
+        retrier = ShardRetrier(self._RETRIES, self._BACKOFF_S,
+                               breaker=breaker_for("http://"))
         try:
             return retrier.call(op, what="http")
         finally:

@@ -28,6 +28,14 @@ protocol. JAX has no task retry, so the equivalents here are:
   ring + JSONL sink (``DISQ_TPU_TRACE_JSONL``, Chrome/Perfetto
   export), and the ``jax.profiler`` bridge (``trace_phase``,
   ``DISQ_TPU_TRACE_DIR``).
+- ``resilience`` — adaptive, closed-loop fault handling layered on
+  ``errors``/``executor``: hedged shard fetches from a rolling latency
+  quantile (``DisqOptions.hedge_quantile``), per-shard deadlines with
+  a retry → hedge → quarantine escalation ladder
+  (``shard_deadline_s``), a process-wide retry token bucket
+  (``retry_budget_tokens``) and per-filesystem circuit breakers
+  (``breaker_window``) that fail fast during fault storms — all free
+  when disabled.
 - ``introspect`` — the live half of observability: an opt-in
   in-process HTTP endpoint (``/metrics`` / ``/healthz`` /
   ``/progress`` / ``/spans``; ``DisqOptions.introspect_port`` /
@@ -55,7 +63,9 @@ from disq_tpu.runtime.counters import (  # noqa: F401
     reduce_counters,
 )
 from disq_tpu.runtime.errors import (  # noqa: F401
+    BreakerOpenError,
     CorruptBlockError,
+    DeadlineExceededError,
     DisqOptions,
     ErrorPolicy,
     ShardErrorContext,
@@ -76,9 +86,20 @@ from disq_tpu.runtime.executor import (  # noqa: F401
     WriteShardTask,
     WriterStats,
     executor_for_storage,
+    map_ordered_resumable,
+    read_ledger_for_storage,
     run_write_stage,
     write_retrier_for_storage,
     writer_for_storage,
+)
+from disq_tpu.runtime.resilience import (  # noqa: F401
+    CircuitBreaker,
+    HedgeController,
+    ResilienceManager,
+    RetryBudget,
+    ShardDeadline,
+    resilience_for_options,
+    reset_resilience,
 )
 from disq_tpu.runtime.cluster import (  # noqa: F401
     ClusterAggregator,
@@ -100,6 +121,7 @@ from disq_tpu.runtime.introspect import (  # noqa: F401
 )
 from disq_tpu.runtime.manifest import (  # noqa: F401
     QuarantineManifest,
+    ReadLedger,
     StageManifest,
 )
 from disq_tpu.runtime.tracing import (  # noqa: F401

@@ -32,6 +32,7 @@ reads are transient; ``ValueError``-family codec errors are corrupt.
 from __future__ import annotations
 
 import enum
+import random
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -110,6 +111,28 @@ class DisqOptions:
     - ``progress_log`` appends a periodic JSONL progress line
       (shards done / in flight / total, records, rolling records/sec,
       ETA) that ``scripts/trace_report.py --progress`` replays.
+
+    Adaptive resilience (``runtime/resilience.py`` — every knob None
+    or default keeps the zero-overhead seed behavior):
+
+    - ``hedge_quantile`` arms hedged fetches: a shard fetch outliving
+      that rolling quantile of this run's fetch latencies (never less
+      than ``hedge_min_s``) races a duplicate, first result wins.
+    - ``shard_deadline_s`` gives each shard a wall-clock budget with
+      an escalation ladder: retry while young → forced hedge past half
+      the budget → ``DeadlineExceededError`` (quarantined under
+      skip/quarantine policy) once it is gone.
+    - ``retry_budget_tokens`` installs the process-wide retry token
+      bucket every ``ShardRetrier`` consults (a retry spends a token,
+      a success refills ``retry_budget_refill``); an empty bucket
+      denies retries so a fault storm cannot stampede the store.
+    - ``breaker_window`` arms the per-filesystem circuit breaker:
+      that many consecutive transient failures open it, calls then
+      fail fast with ``BreakerOpenError`` until a successful probe
+      after ``breaker_cooldown_s`` recloses it.
+    - ``read_ledger`` points the crash-resumable *read* ledger at a
+      directory: each decoded shard is spilled there as it emits, and
+      a killed process re-runs only unfinished shards on restart.
     """
 
     error_policy: ErrorPolicy = ErrorPolicy.STRICT
@@ -125,6 +148,14 @@ class DisqOptions:
     watchdog_stall_s: Optional[float] = None
     watchdog_policy: str = "warn"
     progress_log: Optional[str] = None
+    hedge_quantile: Optional[float] = None
+    hedge_min_s: float = 0.05
+    shard_deadline_s: Optional[float] = None
+    retry_budget_tokens: Optional[int] = None
+    retry_budget_refill: float = 0.1
+    breaker_window: Optional[int] = None
+    breaker_cooldown_s: float = 1.0
+    read_ledger: Optional[str] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -153,6 +184,43 @@ class DisqOptions:
                 f"watchdog_policy must be 'warn' or 'abort', got {policy!r}")
         return replace(self, watchdog_stall_s=float(stall_s),
                        watchdog_policy=policy)
+
+    def with_hedging(self, quantile: float,
+                     min_s: float = 0.05) -> "DisqOptions":
+        if not 0.0 < quantile < 1.0:
+            raise ValueError(
+                f"hedge_quantile must be in (0, 1), got {quantile}")
+        if min_s < 0:
+            raise ValueError(f"hedge_min_s must be >= 0, got {min_s}")
+        return replace(self, hedge_quantile=float(quantile),
+                       hedge_min_s=float(min_s))
+
+    def with_shard_deadline(self, deadline_s: float) -> "DisqOptions":
+        if deadline_s <= 0:
+            raise ValueError(
+                f"shard_deadline_s must be > 0, got {deadline_s}")
+        return replace(self, shard_deadline_s=float(deadline_s))
+
+    def with_retry_budget(self, tokens: int,
+                          refill_per_success: float = 0.1) -> "DisqOptions":
+        if tokens < 1:
+            raise ValueError(
+                f"retry_budget_tokens must be >= 1, got {tokens}")
+        return replace(self, retry_budget_tokens=int(tokens),
+                       retry_budget_refill=float(refill_per_success))
+
+    def with_breaker(self, window: int,
+                     cooldown_s: float = 1.0) -> "DisqOptions":
+        if window < 1:
+            raise ValueError(f"breaker_window must be >= 1, got {window}")
+        if cooldown_s <= 0:
+            raise ValueError(
+                f"breaker_cooldown_s must be > 0, got {cooldown_s}")
+        return replace(self, breaker_window=int(window),
+                       breaker_cooldown_s=float(cooldown_s))
+
+    def with_read_ledger(self, path: str) -> "DisqOptions":
+        return replace(self, read_ledger=path)
 
 
 class CorruptBlockError(ValueError):
@@ -216,6 +284,40 @@ class WatchdogStallError(RuntimeError):
         self.direction = direction
 
 
+class DeadlineExceededError(RuntimeError):
+    """A shard exhausted its ``DisqOptions.shard_deadline_s`` budget —
+    the terminal rung of the resilience escalation ladder (retry →
+    hedge → this).  A *certain*, non-transient kind: retrying work the
+    deadline already declared over-budget would defeat the deadline.
+    Under skip/quarantine policy the sources convert it into a
+    quarantined empty shard instead of aborting the run."""
+
+    def __init__(self, message: str, *, shard_id: int = -1,
+                 elapsed_s: float = 0.0, deadline_s: float = 0.0) -> None:
+        detail = (f"{message} [shard={shard_id} "
+                  f"elapsed={elapsed_s:.3f}s deadline={deadline_s:.3f}s]")
+        super().__init__(detail)
+        self.shard_id = shard_id
+        self.elapsed_s = elapsed_s
+        self.deadline_s = deadline_s
+
+
+class BreakerOpenError(RuntimeError):
+    """The circuit breaker guarding a filesystem is open: the call was
+    rejected *before* touching the store (``runtime/resilience.py``).
+    Non-transient by classification — the breaker exists precisely to
+    stop retry loops from hammering a store it has declared degraded;
+    callers should surface the failure (or wait ``retry_after_s``)."""
+
+    def __init__(self, message: str, *, key: str = "",
+                 retry_after_s: float = 0.0) -> None:
+        super().__init__(
+            f"{message} [filesystem={key or '?'} "
+            f"retry_after={retry_after_s:.3f}s]")
+        self.key = key
+        self.retry_after_s = retry_after_s
+
+
 class TruncatedReadError(OSError, ValueError):
     """A range read returned fewer bytes than the on-disk structure
     requires. Subclasses ``OSError`` (it is an I/O symptom — a flaky
@@ -237,7 +339,8 @@ def is_transient(exc: BaseException) -> bool:
     """Transient (retryable) vs. permanent/corrupt classification."""
     if isinstance(exc, TransientIOError):
         return True
-    if isinstance(exc, (CorruptBlockError, WatchdogStallError)):
+    if isinstance(exc, (CorruptBlockError, WatchdogStallError,
+                        DeadlineExceededError, BreakerOpenError)):
         return False
     if isinstance(exc, _PERMANENT_OS_ERRORS):
         return False
@@ -264,15 +367,51 @@ def is_transient(exc: BaseException) -> bool:
     return isinstance(exc, OSError)
 
 
+# Shared fallback RNG for backoff jitter: module-wide so concurrent
+# retriers draw *different* sleeps even when none injects its own.
+_JITTER_RNG = random.Random()
+
+_resilience = None  # lazily bound module ref (avoids an import cycle)
+
+
+def _resilience_mod():
+    global _resilience
+    if _resilience is None:
+        from disq_tpu.runtime import resilience
+
+        _resilience = resilience
+    return _resilience
+
+
 class ShardRetrier:
-    """Bounded retry with exponential backoff for transient faults —
-    the analogue of Spark task retry, scoped to one shard's work.
+    """Bounded retry with decorrelated-jitter backoff for transient
+    faults — the analogue of Spark task retry, scoped to one shard's
+    work.
 
     ``call(fn, ...)`` runs ``fn`` up to ``1 + max_retries`` times,
     retrying only when ``is_transient`` says the failure is worth it.
-    Retries are counted in ``.retried`` and traced as
-    ``retry.<what>`` phases so a flaky store is visible in
-    ``phase_report()``.
+    Retries are counted in ``.retried`` and traced as ``retry.<what>``
+    phases so a flaky store is visible in ``phase_report()``.
+
+    Backoff uses *decorrelated jitter* (``sleep = uniform(base, 3 ×
+    prev)``, capped at ``base × 2^max_retries``) instead of bare
+    exponential doubling: N parallel workers that all failed in the
+    same instant must not come back in lockstep against the very store
+    that just dropped them.  ``rng`` is injectable (seeded) so tests
+    stay deterministic; the default draws from a process-shared RNG so
+    sibling shards decorrelate.
+
+    The retrier is also the resilience layer's choke point
+    (``runtime/resilience.py``; every hook below is a no-op until the
+    matching ``DisqOptions`` knob configures it):
+
+    - the process-wide ``RetryBudget`` is consulted before every
+      retry — a dry bucket denies it and the original error surfaces;
+    - an attached per-filesystem ``CircuitBreaker`` gates each attempt
+      (``BreakerOpenError`` while open) and is fed every transient
+      outcome;
+    - an attached ``ShardDeadline`` ends retrying with a
+      ``DeadlineExceededError`` once the shard's budget is spent.
     """
 
     def __init__(
@@ -280,28 +419,79 @@ class ShardRetrier:
         max_retries: int = 3,
         backoff_s: float = 0.05,
         sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+        breaker=None,
     ) -> None:
         self.max_retries = max_retries
         self.backoff_s = backoff_s
         self._sleep = sleep
+        self._rng = rng if rng is not None else _JITTER_RNG
         self.retried = 0
+        # Resilience attachments (None = the zero-overhead default).
+        self.breaker = breaker
+        self.deadline = None
+
+    def _next_backoff(self, prev: float) -> float:
+        """Decorrelated jitter: uniform in [base, 3 × prev], capped at
+        the old schedule's terminal value so worst-case total sleep
+        stays the same order as before."""
+        base = self.backoff_s
+        if base <= 0:
+            return 0.0
+        cap = base * (2 ** max(1, self.max_retries))
+        return min(cap, self._rng.uniform(base, max(base, prev * 3)))
 
     def call(self, fn: Callable[..., T], *args: Any,
              what: str = "read", **kwargs: Any) -> T:
         from disq_tpu.runtime.tracing import counter, span
 
         attempt = 0
+        prev_sleep = self.backoff_s
+        if self.deadline is not None:
+            # The shard's wall-clock budget starts with its first
+            # attempt, not with its first failure.
+            self.deadline.arm()
         while True:
+            if self.breaker is not None:
+                self.breaker.before_call()
             try:
-                return fn(*args, **kwargs)
+                result = fn(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — classified below
-                if not is_transient(e) or attempt >= self.max_retries:
+                transient = is_transient(e)
+                if self.breaker is not None:
+                    if transient:
+                        self.breaker.record_failure()
+                    else:
+                        # Not a store fault (corrupt data, 404, config
+                        # error): no state-machine event, but a
+                        # half-open probe slot must be released or the
+                        # breaker wedges in half_open.
+                        self.breaker.release_probe()
+                if not transient or attempt >= self.max_retries:
                     raise
+                if self.deadline is not None:
+                    # Escalation ladder terminal: no more retries once
+                    # the shard's wall-clock budget is gone.
+                    try:
+                        self.deadline.check(what=what)
+                    except Exception as deadline_exc:
+                        raise deadline_exc from e
+                budget = _resilience_mod().active_budget()
+                if budget is not None and not budget.try_spend(what=what):
+                    raise  # bucket dry: the storm must not stampede
                 attempt += 1
                 self.retried += 1
                 counter("retry.attempts").inc(what=what)
+                prev_sleep = self._next_backoff(prev_sleep)
                 with span("retry.backoff", what=what, attempt=attempt):
-                    self._sleep(self.backoff_s * (2 ** (attempt - 1)))
+                    self._sleep(prev_sleep)
+            else:
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                budget = _resilience_mod().active_budget()
+                if budget is not None:
+                    budget.on_success()
+                return result
 
 
 @dataclass
@@ -327,7 +517,8 @@ class ShardErrorContext:
             shard_id=shard_id,
             retrier=ShardRetrier(
                 self.retrier.max_retries, self.retrier.backoff_s,
-                self.retrier._sleep,
+                self.retrier._sleep, rng=self.retrier._rng,
+                breaker=self.retrier.breaker,
             ),
             quarantine=self.quarantine,
             quarantine_dir=self.quarantine_dir,
@@ -440,12 +631,49 @@ def context_for_storage(storage, path: str) -> ShardErrorContext:
         from disq_tpu.runtime.tracing import start_span_log
 
         start_span_log(opts.span_log)
+    breaker = None
+    if (getattr(opts, "retry_budget_tokens", None) is not None
+            or getattr(opts, "breaker_window", None) is not None):
+        res = _resilience_mod()
+        res.configure_globals_from_options(opts)
+        breaker = res.breaker_for(path)
     return ShardErrorContext(
         policy=ErrorPolicy.coerce(opts.error_policy),
         path=path,
-        retrier=ShardRetrier(opts.max_retries, opts.retry_backoff_s),
+        retrier=ShardRetrier(opts.max_retries, opts.retry_backoff_s,
+                             breaker=breaker),
         quarantine_dir=opts.quarantine_dir,
     )
+
+
+def deadline_fallback_for(opts, shard_ctx,
+                          make_empty: Callable[[], T]
+                          ) -> Optional[Callable[[], T]]:
+    """Build a ``ShardTask.deadline_fallback`` for one shard: under
+    skip/quarantine policy with ``shard_deadline_s`` armed, a shard
+    whose deadline expires is booked through the shard's existing
+    corrupt-block machinery (counted, and under QUARANTINE recorded in
+    the manifest with ``kind="shard deadline"``) and replaced by
+    ``make_empty()``'s stand-in value.  STRICT — or no deadline — gets
+    None: the ``DeadlineExceededError`` then aborts the run, which is
+    exactly the strict contract."""
+    if getattr(opts, "shard_deadline_s", None) is None:
+        return None
+    if shard_ctx is None or shard_ctx.policy is ErrorPolicy.STRICT:
+        return None
+
+    def fallback() -> T:
+        shard_ctx.handle_corrupt_block(
+            DeadlineExceededError(
+                "shard deadline exceeded — shard set aside",
+                shard_id=shard_ctx.shard_id,
+                deadline_s=float(opts.shard_deadline_s)),
+            block_offset=-1,
+            kind="shard deadline",
+        )
+        return make_empty()
+
+    return fallback
 
 
 # -- BGZF salvage ----------------------------------------------------------

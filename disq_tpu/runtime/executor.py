@@ -67,8 +67,18 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
-from disq_tpu.runtime.errors import DisqOptions, ShardRetrier, is_transient
+from disq_tpu.runtime.errors import (
+    DeadlineExceededError,
+    DisqOptions,
+    ShardRetrier,
+    is_transient,
+)
 from disq_tpu.runtime.tracing import observe_gauge, record_span, span
+
+# Sentinel a fetch stage emits when the shard's deadline expired and
+# the task carries a fallback: the decode stage then produces the
+# fallback value instead of decoding (runtime/resilience.py ladder).
+_DEADLINE_MISS = object()
 
 
 @dataclass
@@ -77,13 +87,21 @@ class ShardTask:
     returns an opaque payload; ``decode`` turns that payload into the
     shard's result (stage B). Both close over their shard's
     ``ShardErrorContext`` for policy dispatch; ``retrier`` is that
-    context's retrier (None ⇒ no transient retry)."""
+    context's retrier (None ⇒ no transient retry).
+
+    ``deadline_fallback`` (set by sources when the error policy is
+    skip/quarantine and ``DisqOptions.shard_deadline_s`` is armed)
+    produces the shard's stand-in value — typically an empty batch,
+    booked through the shard's quarantine machinery — when the shard's
+    deadline expires; without it a ``DeadlineExceededError`` aborts the
+    run (the strict-policy behavior)."""
 
     shard_id: int
     fetch: Callable[[], Any]
     decode: Callable[[Any], Any]
     retrier: Optional[ShardRetrier] = None
     what: str = "shard"
+    deadline_fallback: Optional[Callable[[], Any]] = None
 
 
 @dataclass
@@ -330,7 +348,8 @@ class ShardPipelineExecutor:
                  prefetch_shards: Optional[int] = None,
                  health=None,
                  watchdog_stall_s: Optional[float] = None,
-                 watchdog_policy: str = "warn") -> None:
+                 watchdog_policy: str = "warn",
+                 resilience=None) -> None:
         self.workers = max(1, int(workers))
         if prefetch_shards is None:
             prefetch_shards = 2 * self.workers
@@ -348,6 +367,10 @@ class ShardPipelineExecutor:
         self._health = health
         self._watchdog_stall_s = watchdog_stall_s
         self._watchdog_policy = watchdog_policy
+        # Adaptive resilience (None = disabled, zero overhead): a
+        # ResilienceManager providing hedged fetches and per-shard
+        # deadlines — see runtime/resilience.py.
+        self._resilience = resilience
 
     # -- public -------------------------------------------------------------
 
@@ -378,8 +401,12 @@ class ShardPipelineExecutor:
     def _run_sequential(self, tasks: List[ShardTask],
                         token: Optional[int] = None
                         ) -> Iterator[ShardResult]:
-        for task in tasks:
-            yield self._run_one_inline(task, token)
+        try:
+            for task in tasks:
+                yield self._run_one_inline(task, token)
+        finally:
+            if self._resilience is not None:
+                self._resilience.close()
 
     def _run_one_inline(self, task: ShardTask,
                         token: Optional[int] = None) -> ShardResult:
@@ -388,17 +415,29 @@ class ShardPipelineExecutor:
         ``retrier.call(decode_range, …)`` per-shard loop."""
         times = [0.0, 0.0]
         health = self._health if token is not None else None
+        res = self._resilience
+        deadline = (res.new_deadline(task.shard_id)
+                    if res is not None else None)
+        if deadline is not None and task.retrier is not None:
+            task.retrier.deadline = deadline
 
         def attempt():
             t0 = time.perf_counter()
             _check_abort(health, token)
+            if deadline is not None:
+                deadline.check(what=task.what)
             if health is not None:
                 health.beat(token, "fetch", task.shard_id)
             with span("executor.fetch", shard=task.shard_id):
-                payload = task.fetch()
+                if res is not None:
+                    payload = res.fetch(task.fetch, task.shard_id, deadline)
+                else:
+                    payload = task.fetch()
             t1 = time.perf_counter()
             times[0] += t1 - t0
             _check_abort(health, token)
+            if deadline is not None:
+                deadline.check(what=task.what)
             if health is not None:
                 health.beat(token, "decode", task.shard_id)
             with span("executor.decode", shard=task.shard_id):
@@ -409,10 +448,15 @@ class ShardPipelineExecutor:
             _check_abort(health, token)
             return value
 
-        if task.retrier is not None:
-            value = task.retrier.call(attempt, what=task.what)
-        else:
-            value = attempt()
+        try:
+            if task.retrier is not None:
+                value = task.retrier.call(attempt, what=task.what)
+            else:
+                value = attempt()
+        except DeadlineExceededError:
+            if task.deadline_fallback is None:
+                raise
+            value = task.deadline_fallback()
         self.stats.fetch_seconds += times[0]
         self.stats.decode_seconds += times[1]
         return ShardResult(task.shard_id, value, times[0], times[1])
@@ -423,19 +467,53 @@ class ShardPipelineExecutor:
                        token: Optional[int] = None
                        ) -> Iterator[ShardResult]:
         """Two stages over the shared bounded core: fetch (with the
-        per-shard retrier) and decode (with the transient-escape
-        refetch hatch)."""
+        per-shard retrier, hedged when resilience is armed) and decode
+        (with the transient-escape refetch hatch)."""
+        res = self._resilience
+        deadlines: Dict[int, Any] = {}
+        if res is not None:
+            for t in tasks:
+                dl = res.new_deadline(t.shard_id)
+                if dl is not None:
+                    deadlines[t.shard_id] = dl
+                    if t.retrier is not None:
+                        t.retrier.deadline = dl
+
+        def fetch_once(task: ShardTask) -> Any:
+            if res is not None:
+                return res.fetch(task.fetch, task.shard_id,
+                                 deadlines.get(task.shard_id))
+            return task.fetch()
 
         def fetch_fn(task: ShardTask, _payload: Any) -> Any:
             with span("executor.fetch", shard=task.shard_id):
-                if task.retrier is not None:
-                    return task.retrier.call(
-                        task.fetch, what=f"{task.what}.fetch")
-                return task.fetch()
+                dl = deadlines.get(task.shard_id)
+                try:
+                    if dl is not None:
+                        dl.check(what=task.what)
+                    if task.retrier is not None:
+                        return task.retrier.call(
+                            lambda: fetch_once(task),
+                            what=f"{task.what}.fetch")
+                    return fetch_once(task)
+                except DeadlineExceededError:
+                    if task.deadline_fallback is None:
+                        raise
+                    return _DEADLINE_MISS
 
         def decode_fn(task: ShardTask, payload: Any) -> Any:
             with span("executor.decode", shard=task.shard_id):
-                return self._decode_with_refetch(task, payload)
+                if payload is _DEADLINE_MISS:
+                    return task.deadline_fallback()
+                dl = deadlines.get(task.shard_id)
+                try:
+                    if dl is not None:
+                        dl.check(what=task.what)
+                    return self._decode_with_refetch(task, payload)
+                except DeadlineExceededError:
+                    if task.deadline_fallback is None:
+                        raise
+                    return task.deadline_fallback()
 
         def on_admit(depth: int) -> None:
             if depth > self.stats.max_in_flight:
@@ -468,9 +546,16 @@ class ShardPipelineExecutor:
         inner = core.run(tasks)  # admits the first window eagerly
 
         def adapt() -> Iterator[ShardResult]:
-            for idx, value, secs in inner:
-                yield ShardResult(tasks[idx].shard_id, value,
-                                  secs[0], secs[1])
+            try:
+                for idx, value, secs in inner:
+                    yield ShardResult(tasks[idx].shard_id, value,
+                                      secs[0], secs[1])
+            finally:
+                # Same lifecycle as the stage pools (core.run's emit
+                # closes them in ITS finally): an abort or exhausted
+                # run must not leave hedge duplicates in flight.
+                if res is not None:
+                    res.close()
 
         return adapt()
 
@@ -497,11 +582,13 @@ class ShardPipelineExecutor:
 def executor_for_storage(storage) -> ShardPipelineExecutor:
     """Build the shard executor from a storage builder's
     ``DisqOptions`` (absent/None ⇒ sequential-compatible defaults).
-    This is also where live introspection turns on for a read: the
-    options' endpoint / watchdog / progress-log knobs are resolved
-    once per run, and the default (nothing configured) hands the
-    executor ``health=None`` — the no-op path."""
+    This is also where live introspection and adaptive resilience turn
+    on for a read: the options' endpoint / watchdog / progress-log /
+    hedging / deadline knobs are resolved once per run, and the
+    default (nothing configured) hands the executor ``health=None`` /
+    ``resilience=None`` — the no-op path."""
     from disq_tpu.runtime.introspect import configure_from_options
+    from disq_tpu.runtime.resilience import resilience_for_options
 
     opts = getattr(storage, "_options", None) or DisqOptions()
     return ShardPipelineExecutor(
@@ -510,7 +597,60 @@ def executor_for_storage(storage) -> ShardPipelineExecutor:
         health=configure_from_options(opts),
         watchdog_stall_s=getattr(opts, "watchdog_stall_s", None),
         watchdog_policy=getattr(opts, "watchdog_policy", "warn"),
+        resilience=resilience_for_options(opts),
     )
+
+
+def read_ledger_for_storage(storage, path: str, n_shards: int):
+    """The crash-resume read ledger for one read, or None when
+    ``DisqOptions.read_ledger`` is unset (the default — no directory,
+    no spill I/O).  The params fingerprint ties the ledger to this
+    exact input shape AND to every option that changes what a shard
+    decodes to (policy, deadline fallback): resuming against a
+    different path, split count, or decode-affecting option resets the
+    ledger instead of serving stale shards."""
+    opts = getattr(storage, "_options", None) or DisqOptions()
+    base = getattr(opts, "read_ledger", None)
+    if not base:
+        return None
+    from disq_tpu.runtime.errors import ErrorPolicy
+    from disq_tpu.runtime.manifest import ReadLedger
+
+    return ReadLedger(base, params={
+        "path": path,
+        "shards": int(n_shards),
+        "error_policy": ErrorPolicy.coerce(opts.error_policy).value,
+        "shard_deadline_s": getattr(opts, "shard_deadline_s", None),
+    })
+
+
+def map_ordered_resumable(executor: ShardPipelineExecutor,
+                          tasks: Sequence[ShardTask],
+                          ledger=None) -> Iterator[ShardResult]:
+    """``executor.map_ordered`` with read-side crash resume: shards the
+    ledger already holds are served from their spills (zero fetch /
+    decode), fresh shards run through the executor and are spilled as
+    they emit, and a fully consumed run hits the ledger's commit point
+    (``finish`` — spills dropped, next run starts clean).  Without a
+    ledger this is exactly ``map_ordered`` (the zero-overhead path)."""
+    tasks = list(tasks)
+    if ledger is None:
+        return executor.map_ordered(tasks)
+
+    def gen() -> Iterator[ShardResult]:
+        cached = {t.shard_id for t in tasks if ledger.is_done(t.shard_id)}
+        fresh = executor.map_ordered(
+            [t for t in tasks if t.shard_id not in cached])
+        for t in tasks:
+            if t.shard_id in cached:
+                yield ShardResult(t.shard_id, ledger.load(t.shard_id))
+            else:
+                res = next(fresh)
+                ledger.record(res.shard_id, res.value)
+                yield res
+        ledger.finish()
+
+    return gen()
 
 
 # ---------------------------------------------------------------------------
@@ -763,12 +903,28 @@ def writer_for_storage(storage) -> ShardWritePipeline:
     )
 
 
-def write_retrier_for_storage(storage) -> ShardRetrier:
+def write_retrier_for_storage(storage, path: Optional[str] = None
+                              ) -> ShardRetrier:
     """A fresh per-shard retrier sized from the storage's retry knobs —
     the write-side analogue of ``context_for_storage().for_shard()``
-    (writes carry no corrupt-block policy, only transient retry)."""
+    (writes carry no corrupt-block policy, only transient retry).
+    With ``path`` and an armed ``breaker_window``, the retrier is also
+    gated by the per-filesystem circuit breaker guarding the output's
+    store, and every write retry draws from the shared retry budget."""
     opts = getattr(storage, "_options", None) or DisqOptions()
-    return ShardRetrier(opts.max_retries, opts.retry_backoff_s)
+    breaker = None
+    if (getattr(opts, "retry_budget_tokens", None) is not None
+            or getattr(opts, "breaker_window", None) is not None):
+        from disq_tpu.runtime.resilience import (
+            breaker_for,
+            configure_globals_from_options,
+        )
+
+        configure_globals_from_options(opts)
+        if path is not None:
+            breaker = breaker_for(path)
+    return ShardRetrier(opts.max_retries, opts.retry_backoff_s,
+                        breaker=breaker)
 
 
 def _retrying(fn: Optional[Callable], retries: int) -> Optional[Callable]:

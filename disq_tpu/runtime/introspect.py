@@ -306,8 +306,13 @@ class PipelineHealth:
 
     def healthz(self) -> Dict[str, Any]:
         """JSON liveness verdict: ``degraded`` while any flagged stall
-        is still active, ``ok`` otherwise (``stall_events`` keeps the
-        historical total either way)."""
+        is still active — or any circuit breaker is open — ``ok``
+        otherwise (``stall_events`` keeps the historical total either
+        way).  When the resilience layer is configured
+        (``runtime/resilience.py``), its state rides along: the retry
+        budget's fill level and every per-filesystem breaker."""
+        from disq_tpu.runtime import resilience
+
         now = time.perf_counter()
         with self._lock:
             stalls = []
@@ -325,7 +330,7 @@ class PipelineHealth:
                         "stage": stage, "age_s": round(now - since, 3),
                         "policy": run.policy,
                     })
-            return {
+            doc = {
                 "status": "degraded" if stalls else "ok",
                 "run_id": RUN_ID,
                 "active_runs": len(self._runs),
@@ -333,6 +338,13 @@ class PipelineHealth:
                 "stall_events": self._stall_events,
                 "stalls": stalls,
             }
+        res = resilience.snapshot()
+        if res:
+            doc["resilience"] = res
+            if any(b["state"] == "open"
+                   for b in res.get("breakers", {}).values()):
+                doc["status"] = "degraded"
+        return doc
 
     @staticmethod
     def _rate(samples: "deque") -> float:

@@ -164,6 +164,81 @@ class StageManifest:
             os.unlink(self.path)
 
 
+class ReadLedger:
+    """Crash-resumable *read* checkpointing — the read-side
+    generalization of ``StageManifest``'s write resume.
+
+    A write stage's shard result is naturally durable (the staged part
+    file); a read shard's result is an in-memory decoded value, so the
+    ledger spills it: as each shard emits from the ordered pipeline,
+    its decoded value is pickled to ``shard-<k>.pkl`` (atomic tmp +
+    rename) and the shard is marked done in an embedded
+    ``StageManifest``.  A killed process restarted with the same ledger
+    directory loads finished shards from their spills and re-runs only
+    the unfinished ones (``runtime/executor.py:map_ordered_resumable``).
+
+    ``params`` fingerprints the input (path, shard count, options that
+    change decoded bytes): resuming against a different input resets
+    the ledger rather than serving stale shards.
+    """
+
+    STAGE = "read.shards"
+
+    def __init__(self, base_dir: str,
+                 params: Optional[Dict[str, Any]] = None) -> None:
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self.manifest = StageManifest(
+            os.path.join(base_dir, "MANIFEST.json"), params)
+
+    def _spill_path(self, shard_id: int) -> str:
+        return os.path.join(self.base_dir, f"shard-{shard_id}.pkl")
+
+    def is_done(self, shard_id: int) -> bool:
+        if not self.manifest.is_done(self.STAGE, shard_id):
+            return False
+        # A recorded shard whose spill vanished (manual cleanup, torn
+        # crash between spill rename and a *future* format change) is
+        # treated as not-done: re-running it is always safe.
+        return os.path.exists(self._spill_path(shard_id))
+
+    def record(self, shard_id: int, value: Any) -> None:
+        import pickle
+
+        spill = self._spill_path(shard_id)
+        fd, tmp = tempfile.mkstemp(dir=self.base_dir, prefix=".shard-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, spill)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self.manifest.mark_done(self.STAGE, shard_id, {"spill": spill})
+
+    def load(self, shard_id: int) -> Any:
+        import pickle
+
+        with open(self._spill_path(shard_id), "rb") as f:
+            return pickle.load(f)
+
+    def completed_shards(self) -> List[int]:
+        return [k for k in self.manifest.completed_shards(self.STAGE)
+                if os.path.exists(self._spill_path(k))]
+
+    def shard_run_id(self, shard_id: int) -> Optional[str]:
+        return self.manifest.shard_run_id(self.STAGE, shard_id)
+
+    def finish(self) -> None:
+        """Commit point: the read completed — drop the manifest and
+        every spill (a later run starts fresh)."""
+        self.manifest.finish()
+        for name in os.listdir(self.base_dir):
+            if name.startswith("shard-") and name.endswith(".pkl"):
+                os.unlink(os.path.join(self.base_dir, name))
+
+
 QUARANTINE_FORMAT_VERSION = 1
 
 

@@ -51,7 +51,7 @@ def _run_sam_shards(storage, fs, dataset, bounds, n_shards, prefix_bytes,
             shard_id=k,
             encode=wrap_span("sam.write.encode", encode, shard=k),
             stage=wrap_span("sam.write.stage", stage, shard=k),
-            retrier=write_retrier_for_storage(storage),
+            retrier=write_retrier_for_storage(storage, part_path_for(k)),
             what="sam.part",
         )
 
@@ -74,7 +74,7 @@ class SamSink:
         try:
             from disq_tpu.runtime.executor import write_retrier_for_storage
 
-            driver = write_retrier_for_storage(self._storage)
+            driver = write_retrier_for_storage(self._storage, path)
             header_path = os.path.join(temp_dir, "_header")
             driver.call(fs.write_all, header_path,
                         dataset.header.text.encode(), what="sam.merge")

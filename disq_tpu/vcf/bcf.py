@@ -661,7 +661,11 @@ class BcfSource:
         from disq_tpu.fsw.filesystem import compute_path_splits, resolve_path
         from disq_tpu.runtime import ShardCounters, ShardTask, reduce_counters
         from disq_tpu.runtime.errors import context_for_storage
-        from disq_tpu.runtime.executor import executor_for_storage
+        from disq_tpu.runtime.executor import (
+            executor_for_storage,
+            map_ordered_resumable,
+            read_ledger_for_storage,
+        )
 
         fs, path = resolve_path(path)
         ctx = context_for_storage(self._storage, path)
@@ -700,7 +704,14 @@ class BcfSource:
 
         parts = []
         shard_counters = []
-        for res in executor_for_storage(self._storage).map_ordered(tasks):
+        # BCF decodes the whole file as one BGZF stream, so a shard may
+        # not be replaced by an empty stand-in (the stream would lose
+        # framing): deadlines here keep the strict abort contract, but
+        # hedging, the retry budget/breaker, and the crash-resume
+        # ledger all apply.
+        ledger = read_ledger_for_storage(self._storage, path, len(tasks))
+        for res in map_ordered_resumable(
+                executor_for_storage(self._storage), tasks, ledger):
             part, n_blocks, c_bytes = res.value
             parts.append(part)
             c = ShardCounters(
@@ -825,7 +836,7 @@ class BcfSink:
         tasks = [make_task(k) for k in range(n_shards)]
         # The stream open is the only faultable write-side call here
         # (stream writes land in the atomic staging file directly).
-        with write_retrier_for_storage(self._storage).call(
+        with write_retrier_for_storage(self._storage, path).call(
                 fs.create, path, what="bcf.create") as out:
             out.write(deflate_blob(build_bcf_header_block(header))[0])
             for res in pipeline.map_ordered(tasks):

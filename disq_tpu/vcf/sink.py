@@ -157,7 +157,7 @@ class VcfSink:
                     "vcf.write.stage",
                     lambda p: self._stage_shard(fs, temp_dir, k, p),
                     shard=k),
-                retrier=write_retrier_for_storage(self._storage),
+                retrier=write_retrier_for_storage(self._storage, path),
                 what="vcf.part",
             )
 
@@ -171,7 +171,7 @@ class VcfSink:
 
         # Driver-side merge writes run under the same transient retry
         # budget as staged parts (atomic create makes retries safe).
-        driver = write_retrier_for_storage(self._storage)
+        driver = write_retrier_for_storage(self._storage, path)
         header_path = os.path.join(temp_dir, "_header")
         if bgz:
             hdr, _ = deflate_blob(header_bytes)
@@ -249,7 +249,7 @@ class VcfSinkMultiple:
                 encode=wrap_span("vcf.write.encode", encode, shard=k),
                 deflate=wrap_span("vcf.write.deflate", deflate, shard=k),
                 stage=wrap_span("vcf.write.stage", stage, shard=k),
-                retrier=write_retrier_for_storage(self._storage),
+                retrier=write_retrier_for_storage(self._storage, path),
                 what="vcf.part",
             )
 

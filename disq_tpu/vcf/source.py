@@ -90,11 +90,15 @@ class VcfSource:
                 functools.partial(lines_for_split, fs, path, s.start, s.end),
                 header, start=s.start, end=s.end,
             ))
-        return self._emit_batches(tasks, shard_ctxs, header)
+        return self._emit_batches(tasks, shard_ctxs, header, path=path)
 
     def _make_task(self, shard_id, shard_ctx, fetch, header,
                    start=None, end=None):
         from disq_tpu.runtime import ShardTask
+        from disq_tpu.runtime.errors import (
+            DisqOptions,
+            deadline_fallback_for,
+        )
         from disq_tpu.runtime.tracing import span, wrap_span
 
         def decode(lines):
@@ -102,6 +106,7 @@ class VcfSource:
                 raw = [ln for ln in lines if ln and not ln.startswith(b"#")]
                 return parse_vcf_lines(raw, header.contig_names)
 
+        opts = getattr(self._storage, "_options", None) or DisqOptions()
         return ShardTask(
             shard_id=shard_id,
             # Per-split timeline spans carrying shard id + byte range.
@@ -110,13 +115,26 @@ class VcfSource:
             decode=decode,
             retrier=shard_ctx.retrier if shard_ctx is not None else None,
             what=f"split{shard_id}",
+            # Over-deadline splits under skip/quarantine become one
+            # quarantined empty batch instead of aborting the read.
+            deadline_fallback=deadline_fallback_for(
+                opts, shard_ctx,
+                lambda: parse_vcf_lines([], header.contig_names)),
         )
 
-    def _emit_batches(self, tasks, shard_ctxs, header) -> VariantBatch:
-        from disq_tpu.runtime.executor import executor_for_storage
+    def _emit_batches(self, tasks, shard_ctxs, header,
+                      path=None) -> VariantBatch:
+        from disq_tpu.runtime.executor import (
+            executor_for_storage,
+            map_ordered_resumable,
+            read_ledger_for_storage,
+        )
 
+        ledger = (read_ledger_for_storage(self._storage, path, len(tasks))
+                  if path is not None else None)
         batches = []
-        for res in executor_for_storage(self._storage).map_ordered(tasks):
+        for res in map_ordered_resumable(
+                executor_for_storage(self._storage), tasks, ledger):
             batches.append(res.value)
             self._track(shard_ctxs[res.shard_id], res.shard_id, res.value)
         return (VariantBatch.concat(batches) if batches
@@ -169,7 +187,7 @@ class VcfSource:
                                   s.start, s.end, length, ctx=shard_ctx),
                 header, start=s.start, end=s.end,
             ))
-        return self._emit_batches(tasks, shard_ctxs, header)
+        return self._emit_batches(tasks, shard_ctxs, header, path=path)
 
     def _inflate_with_gaps(self, data, blocks, gaps, base: int, ctx):
         """``_inflate_with_policy`` when the block walk itself needed

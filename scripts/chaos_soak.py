@@ -53,7 +53,8 @@ def build_fixture(tmp_dir: str, n_records: int, seed: int):
     return path, data, len(records)
 
 
-def random_schedule(rng: random.Random, watchdog: bool = False):
+def random_schedule(rng: random.Random, watchdog: bool = False,
+                    hedge: bool = False):
     from disq_tpu.fsw import FaultSpec
 
     faults = [
@@ -66,6 +67,12 @@ def random_schedule(rng: random.Random, watchdog: bool = False):
     if rng.random() < 0.3:
         faults.append(FaultSpec(
             kind="stall", probability=0.02, stall_s=0.0))
+    if hedge:
+        # --hedge leg: a seeded slow tail on reads so the hedge timer
+        # actually fires (threshold floors at 5ms below); recovery
+        # contract unchanged — hedged output must stay byte-identical.
+        faults.append(FaultSpec(
+            kind="slow", probability=0.25, slow_s=0.05))
     # Write-side blips (op="write" never fires on reads): the staged
     # parts' write_all/concat calls, which the writer's per-shard
     # retrier must absorb without changing a byte.
@@ -150,7 +157,8 @@ def soak_write(ds, path, it_seed: int, writer_workers: int,
 def run_iteration(path, data, n_records, baseline, it_seed: int,
                   executor_workers: int = 1,
                   writer_workers: int = 1,
-                  watchdog: bool = False) -> str:
+                  watchdog: bool = False,
+                  hedge: bool = False) -> str:
     """One soak iteration; returns "" on success, else a description."""
     import numpy as np
 
@@ -168,7 +176,7 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
     )
 
     rng = random.Random(it_seed)
-    faults = random_schedule(rng, watchdog=watchdog)
+    faults = random_schedule(rng, watchdog=watchdog, hedge=hedge)
     policy = rng.choice(["strict", "skip", "quarantine", "recover"])
     corrupt_at = None
     if policy != "recover":
@@ -195,6 +203,12 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
         # stalls are zero-length so nothing should be flagged, but
         # every heartbeat path runs under chaos.
         opts = opts.with_watchdog(0.25, "warn")
+    if hedge:
+        # --hedge leg: hedge aggressively (median quantile, 5ms floor)
+        # against the injected slow tail; the iteration's byte-identity
+        # / bounded-loss checks below ARE the hedging contract, and
+        # main() additionally asserts launched == won accounting.
+        opts = opts.with_hedging(0.5, 0.005)
     storage = ReadsStorage.make_default().split_size(SPLIT).options(opts)
 
     try:
@@ -233,6 +247,197 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
     return ""
 
 
+def breaker_leg(path, baseline) -> str:
+    """Deterministic circuit-breaker scenario: a total fault storm must
+    trip the breaker within its window, rejected calls must fail fast
+    (<10ms each), and after the storm clears a half-open probe must
+    reclose it with output byte-identical to the baseline."""
+    import time as _time
+
+    import numpy as np
+
+    from disq_tpu import BreakerOpenError, DisqOptions, ReadsStorage
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        FaultSpec,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+    from disq_tpu.runtime import reset_resilience
+    from disq_tpu.runtime.resilience import breakers_snapshot
+    from disq_tpu.runtime.tracing import counter
+
+    reset_resilience()
+    try:
+        storm = FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(),
+            [FaultSpec(kind="transient", probability=1.0)])
+        register_filesystem("fault", storm)
+        opts = DisqOptions(max_retries=8, retry_backoff_s=0.0,
+                           ).with_breaker(3, cooldown_s=0.2)
+        st = ReadsStorage.make_default().split_size(SPLIT).options(opts)
+        trips0 = counter("breaker.transitions").value(key="fault",
+                                                      to="open")
+        try:
+            st.read("fault://" + path)
+            return "breaker: storm read unexpectedly succeeded"
+        except BreakerOpenError:
+            pass  # the expected fast failure
+        except Exception as e:  # noqa: BLE001 — storm may surface first
+            if counter("breaker.transitions").value(
+                    key="fault", to="open") <= trips0:
+                return (f"breaker: storm surfaced {type(e).__name__} "
+                        "without tripping the breaker")
+        snap = breakers_snapshot().get("fault")
+        if snap is None or snap["state"] != "open":
+            return f"breaker: expected open after the storm, got {snap}"
+        # While open: rejections must be immediate (<10ms per call).
+        t0 = _time.perf_counter()
+        try:
+            st.read("fault://" + path)
+            return "breaker: open breaker admitted a read"
+        except BreakerOpenError:
+            pass
+        per_call = (_time.perf_counter() - t0)
+        if per_call > 0.25:
+            return (f"breaker: open-state read took {per_call:.3f}s — "
+                    "not failing fast")
+        if counter("breaker.rejected").value(key="fault") <= 0:
+            return "breaker: no breaker.rejected bookings while open"
+        # Storm over: after the cooldown a probe must reclose it.
+        storm.faults.clear()
+        _time.sleep(0.25)
+        ds = st.read("fault://" + path)
+        snap = breakers_snapshot().get("fault")
+        if snap is None or snap["state"] != "closed":
+            return (f"breaker: expected reclose after probe, got {snap}")
+        if ds.count() != baseline.count() or not np.array_equal(
+                ds.reads.pos, baseline.reads.pos):
+            return "breaker: post-reclose read differs from baseline"
+        return ""
+    finally:
+        reset_resilience()
+
+
+_KILL_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from disq_tpu import DisqOptions, ReadsStorage
+from disq_tpu.api import StageManifestWriteOption
+from disq_tpu.fsw import (FaultInjectingFileSystemWrapper, FaultSpec,
+                          PosixFileSystemWrapper, register_filesystem)
+
+# Wedge the 4th write-side call for 120s: a couple of parts land, the
+# manifest records them, then the writer hangs until SIGKILL.
+register_filesystem("fault", FaultInjectingFileSystemWrapper(
+    PosixFileSystemWrapper(),
+    [FaultSpec(kind="stall", op="write", stall_s=120.0, call_index=3,
+               times=1)]))
+ds = ReadsStorage.make_default().split_size({split}).read({path!r})
+st = (ReadsStorage.make_default().num_shards(6)
+      .options(DisqOptions(retry_backoff_s=0.0))
+      .writer_workers(2))
+st.write(ds, "fault://" + {out!r}, StageManifestWriteOption({mpath!r}))
+"""
+
+
+def kill_leg(path, tmp) -> str:
+    """SIGKILL a writer subprocess mid-run, then resume from its
+    ``StageManifest``: only unfinished shards may re-run (asserted via
+    the ledger's completed set against the resumed process's write
+    log), and the final bytes must match a fault-free run."""
+    import json
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    from disq_tpu import DisqOptions, ReadsStorage, StageManifest
+    from disq_tpu.api import StageManifestWriteOption
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(tmp, "kill-out.bam")
+    mpath = os.path.join(tmp, "kill.manifest")
+    child = subprocess.Popen(
+        [_sys.executable, "-c", _KILL_CHILD.format(
+            repo=repo, split=SPLIT, path=path, out=out, mpath=mpath)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    # Wait until the child's manifest records >= 2 staged shards, then
+    # kill -9 mid-run (one stage worker is wedged on the injected
+    # stall, so the process is alive and mid-write when it dies).
+    deadline = _time.monotonic() + 120
+    done = []
+    while _time.monotonic() < deadline:
+        if child.poll() is not None:
+            return ("kill: writer child exited early: "
+                    + child.stderr.read().decode(errors="replace")[-500:])
+        try:
+            with open(mpath) as f:
+                state = json.load(f)
+            done = sorted(
+                int(k) for k in state.get("stages", {})
+                .get("bam.parts", {}).get("shards", {}))
+        except (OSError, json.JSONDecodeError, ValueError):
+            done = []
+        if len(done) >= 2:
+            break
+        _time.sleep(0.05)
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    if len(done) < 2:
+        return "kill: child never staged 2 shards before the deadline"
+
+    # Ledger snapshot before resuming: which shards the killed run
+    # completed, stamped with ITS run id.
+    manifest = StageManifest(mpath)
+    pre_done = manifest.completed_shards("bam.parts")
+    child_runs = {k: manifest.shard_run_id("bam.parts", k)
+                  for k in pre_done}
+    if set(pre_done) != set(done) or None in child_runs.values():
+        return f"kill: torn ledger after SIGKILL: {pre_done} vs {done}"
+
+    # Resume fault-free through a write-logging fs: completed shards
+    # must NOT be re-staged; the rest must.
+    class _Counting(PosixFileSystemWrapper):
+        writes = []
+
+        def write_all(self, p, data):
+            _Counting.writes.append(p)
+            super().write_all(p, data)
+
+    register_filesystem("fault", FaultInjectingFileSystemWrapper(
+        _Counting(), []))
+    ds = ReadsStorage.make_default().split_size(SPLIT).read(path)
+    st = (ReadsStorage.make_default().num_shards(6)
+          .options(DisqOptions(retry_backoff_s=0.0))
+          .writer_workers(2))
+    st.write(ds, "fault://" + out, StageManifestWriteOption(mpath))
+    staged = {int(p.rsplit("part-", 1)[1][:5])
+              for p in _Counting.writes if "part-" in p}
+    if staged & set(pre_done):
+        return (f"kill: resume re-staged completed shards "
+                f"{sorted(staged & set(pre_done))} (ledger said done)")
+    if staged != set(range(6)) - set(pre_done):
+        return (f"kill: resume staged {sorted(staged)}, expected exactly "
+                f"the unfinished {sorted(set(range(6)) - set(pre_done))}")
+    if os.path.exists(mpath):
+        return "kill: manifest survived the commit point"
+
+    clean = os.path.join(tmp, "kill-clean.bam")
+    ReadsStorage.make_default().num_shards(6).write(ds, clean)
+    with open(out, "rb") as fa, open(clean, "rb") as fb:
+        if fa.read() != fb.read():
+            return "kill: resumed output differs from a fault-free run"
+    return ""
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--iterations", type=int, default=20)
@@ -258,6 +463,24 @@ def main(argv=None) -> int:
                          "watchdog.stalled_shards flags it within the "
                          "window (stall-kind legs assert detection, not "
                          "just recovery)")
+    ap.add_argument("--hedge", action="store_true",
+                    help="arm hedged fetches and inject a seeded slow "
+                         "tail on reads: every iteration's byte-identity "
+                         "contract must hold under racing duplicates, "
+                         "and hedge accounting (launched == won) is "
+                         "asserted at the end")
+    ap.add_argument("--breaker", action="store_true",
+                    help="run the deterministic circuit-breaker leg: a "
+                         "total fault storm must trip the breaker within "
+                         "its window, open-state reads must fail fast, "
+                         "and a half-open probe must reclose it with "
+                         "byte-identical output")
+    ap.add_argument("--kill", action="store_true",
+                    help="run the crash-resume leg: SIGKILL a writer "
+                         "subprocess mid-run, resume from its "
+                         "StageManifest, assert only unfinished shards "
+                         "re-ran (via the ledger) and the final bytes "
+                         "match a fault-free run")
     args = ap.parse_args(argv)
 
     from disq_tpu import ReadsStorage
@@ -271,11 +494,32 @@ def main(argv=None) -> int:
             err = run_iteration(path, data, n_records, baseline, it_seed,
                                 executor_workers=args.executor_workers,
                                 writer_workers=args.writer_workers,
-                                watchdog=args.watchdog)
+                                watchdog=args.watchdog,
+                                hedge=args.hedge)
             status = "ok" if not err else f"FAIL: {err}"
             print(f"[{i + 1}/{args.iterations}] seed={it_seed} {status}")
             if err:
                 failures.append((it_seed, err))
+        if args.hedge:
+            from disq_tpu.runtime.tracing import counter
+
+            launched = counter("hedge.launched").total()
+            won = counter("hedge.won").total()
+            if launched != won:
+                failures.append((args.seed, (
+                    f"hedge accounting out of balance: {launched} "
+                    f"launched, {won} won bookings")))
+            print(f"[hedge] {int(launched)} launched, all accounted")
+        if args.breaker:
+            err = breaker_leg(path, baseline)
+            print(f"[breaker] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.kill:
+            err = kill_leg(path, tmp)
+            print(f"[kill] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
         print(f"{len(failures)} mismatches in {args.iterations} iterations")
         for it_seed, err in failures:
             print(f"  seed={it_seed}: {err}")

@@ -47,6 +47,10 @@ ALLOWED_PREFIXES = {
     # kernel spans, transfer counters, HBM gauge; and the cluster
     # aggregator's scrape telemetry (runtime/cluster.py).
     "device", "cluster",
+    # Adaptive resilience (runtime/resilience.py): hedged-fetch
+    # bookkeeping, circuit-breaker state machine, per-shard deadline
+    # escalation, and the shared retry token bucket.
+    "hedge", "breaker", "deadline", "budget",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

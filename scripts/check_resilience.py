@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""check_resilience — invariant lint for the adaptive resilience layer
+(tier-1 via ``tests/test_resilience_check.py``, like check_overhead).
+
+Three invariant families, each cheap enough for CI:
+
+1. **Breaker state machine is total.** Every ``(state, event)`` pair —
+   states closed/open/half_open, events success/failure/gated-call at
+   any clock — must land in a defined state, and only the legal edges
+   may ever be taken: closed→open, open→half_open, half_open→closed,
+   half_open→open.  Driven exhaustively with an injected fake clock.
+2. **Hedge bookkeeping balances.** In a sample hedged run, every
+   ``hedge.launched`` has exactly one matching ``hedge.won`` booking
+   (labeled ``winner=primary|hedge``) — a launch that is neither won
+   nor lost would mean a leaked duplicate.
+3. **The disabled path is actually disabled.** Default ``DisqOptions``
+   configure no budget, no breaker, no hedge controller; a read with
+   every resilience knob off spawns no ``disq-hedge`` thread and no
+   timer; and a read with hedging *on* produces records byte-identical
+   to the seed path (hedging may change timing, never bytes).
+
+Run directly: ``python scripts/check_resilience.py`` (exit 0 ok).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LEGAL_EDGES = {
+    ("closed", "open"),
+    ("open", "half_open"),
+    ("half_open", "closed"),
+    ("half_open", "open"),
+}
+
+
+def check_breaker_totality(errors):
+    """Drive a breaker through every (state, event) pair and record the
+    edges taken; anything outside LEGAL_EDGES — or any crash — fails."""
+    from disq_tpu.runtime.errors import BreakerOpenError
+    from disq_tpu.runtime.resilience import CircuitBreaker
+
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    taken = set()
+
+    def drive(br, event):
+        before = br.state
+        if event == "success":
+            br.record_success()
+        elif event == "failure":
+            br.record_failure()
+        elif event == "call":
+            try:
+                br.before_call()
+            except BreakerOpenError:
+                pass
+        elif event == "call_after_cooldown":
+            now[0] += br.cooldown_s + 1.0
+            try:
+                br.before_call()
+            except BreakerOpenError:
+                pass
+        after = br.state
+        if before != after:
+            taken.add((before, after))
+        if after not in ("closed", "open", "half_open"):
+            errors.append(
+                f"breaker reached undefined state {after!r} "
+                f"from {before!r} on {event}")
+
+    def fresh(state):
+        # window=1 so a single driven failure takes the closed->open
+        # edge INSIDE drive() (the edge-coverage check below needs
+        # every legal edge exercised by a recorded event).
+        br = CircuitBreaker("probe", window=1, cooldown_s=10.0, clock=clock)
+        if state in ("open", "half_open"):
+            br.record_failure()          # closed -> open
+        if state == "half_open":
+            now[0] += br.cooldown_s + 1.0
+            try:
+                br.before_call()         # open -> half_open (probe)
+            except BreakerOpenError:
+                pass
+        if br.state != state:
+            errors.append(
+                f"could not construct breaker in state {state!r} "
+                f"(got {br.state!r})")
+        return br
+
+    for state in ("closed", "open", "half_open"):
+        for event in ("success", "failure", "call", "call_after_cooldown"):
+            drive(fresh(state), event)
+
+    illegal = taken - LEGAL_EDGES
+    if illegal:
+        errors.append(f"breaker took illegal transitions: {sorted(illegal)}")
+    # The exhaustive drive must exercise the full legal edge set — a
+    # machine that can never reclose is as broken as one that jumps.
+    missing = LEGAL_EDGES - taken
+    if missing:
+        errors.append(
+            f"breaker never took expected transitions: {sorted(missing)}")
+
+
+def check_hedge_accounting(errors):
+    """Sample hedged workload: slow fetches force launches, and every
+    launch must book exactly one ``hedge.won``."""
+    from disq_tpu.runtime.resilience import HedgeController
+    from disq_tpu.runtime.tracing import counter
+
+    launched0 = counter("hedge.launched").total()
+    won0 = counter("hedge.won").total()
+    hedge = HedgeController(quantile=0.9, min_s=0.01)
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def fetch():
+        with lock:
+            calls["n"] += 1
+            k = calls["n"]
+        # Odd calls are the slow tail (outlive min_s), even calls are
+        # fast — so primaries hedge and duplicates win.
+        time.sleep(0.05 if k % 2 else 0.001)
+        return b"x" * 64
+
+    for shard in range(4):
+        out = hedge.call(fetch, shard_id=shard)
+        if out != b"x" * 64:
+            errors.append("hedged call returned a wrong payload")
+    hedge.close()
+    time.sleep(0.1)  # let loser done-callbacks land
+    launched = counter("hedge.launched").total() - launched0
+    won = counter("hedge.won").total() - won0
+    if launched == 0:
+        errors.append("sample run launched no hedges (slow tail at 50ms "
+                      "vs 10ms threshold should always hedge)")
+    if launched != won:
+        errors.append(
+            f"hedge bookkeeping out of balance: {launched} launched but "
+            f"{won} won bookings — a launch leaked without a winner")
+
+
+def check_disabled_path(errors):
+    """No knob ⇒ no manager, no budget, no breaker, no thread; and
+    hedging on ⇒ identical decoded records."""
+    import tempfile
+
+    import numpy as np
+
+    from disq_tpu import DisqOptions, ReadsStorage
+    from disq_tpu.runtime.resilience import (
+        active_budget,
+        breaker_for,
+        breakers_snapshot,
+        reset_resilience,
+        resilience_for_options,
+    )
+
+    reset_resilience()
+    if resilience_for_options(DisqOptions()) is not None:
+        errors.append(
+            "resilience_for_options(default DisqOptions) returned a "
+            "manager — the executor would touch resilience per shard")
+    if active_budget() is not None:
+        errors.append("a retry budget exists with no knob configured")
+    if breaker_for("/tmp/x") is not None or breakers_snapshot():
+        errors.append("a breaker exists with no knob configured")
+
+    from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+    with tempfile.TemporaryDirectory(prefix="resilience-check-") as tmp:
+        path = os.path.join(tmp, "t.bam")
+        with open(path, "wb") as f:
+            f.write(make_bam_bytes(
+                DEFAULT_REFS, synth_records(300, seed=11), blocksize=600))
+        plain = ReadsStorage.make_default().split_size(4096).read(path)
+        stray = [t.name for t in threading.enumerate()
+                 if t.name.startswith("disq-hedge")]
+        if stray:
+            errors.append(
+                f"default-path read spawned hedge threads: {stray}")
+        hedged = (ReadsStorage.make_default().split_size(4096)
+                  .hedged_fetches(0.5, 0.0)   # hedge EVERY fetch
+                  .executor_workers(2)
+                  .read(path))
+        if plain.count() != hedged.count() or not (
+                np.array_equal(plain.reads.pos, hedged.reads.pos)
+                and np.array_equal(plain.reads.names, hedged.reads.names)):
+            errors.append(
+                "hedged read differs from the seed path — hedging must "
+                "change timing, never bytes")
+        # Write both back: the staged bytes must also be identical.
+        out_a, out_b = os.path.join(tmp, "a.bam"), os.path.join(tmp, "b.bam")
+        ReadsStorage.make_default().num_shards(4).write(plain, out_a)
+        ReadsStorage.make_default().num_shards(4).write(hedged, out_b)
+        with open(out_a, "rb") as fa, open(out_b, "rb") as fb:
+            if fa.read() != fb.read():
+                errors.append("write-back of a hedged read is not "
+                              "byte-identical to the seed path")
+    reset_resilience()
+
+
+def main() -> int:
+    errors = []
+    check_breaker_totality(errors)
+    check_hedge_accounting(errors)
+    check_disabled_path(errors)
+    if errors:
+        print(f"check_resilience: {len(errors)} problem(s)")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print("check_resilience: OK (breaker machine total, hedge "
+          "accounting balanced, disabled path clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

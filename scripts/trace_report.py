@@ -74,6 +74,11 @@ CATEGORIES = (
     # kernel execution and explicit h2d/d2h transfer phases.
     ("device", "K", ("device.kernel",)),
     ("transfer", "T", ("device.transfer",)),
+    # Hedged duplicate fetches (runtime/resilience.py): the duplicate's
+    # own execution (hedge.fetch) and the loser's burned time
+    # (hedge.waste) both paint H — a hedge racing its primary is
+    # visible as overlap on the shard's row.
+    ("hedge", "H", ("hedge.",)),
     ("emit_stall", "s", ("executor.emit.stall", "writer.emit.stall")),
     ("retry", "r", ("retry.",)),
     ("quarantine", "q", ("quarantine.",)),
@@ -150,16 +155,33 @@ def fmt_s(v: float) -> str:
     return f"{v * 1e3:7.2f}ms"
 
 
+# Breaker-window shading (runtime/resilience.py): the open window is a
+# solid band, the half-open probe window a lighter one.
+_BREAKER_GLYPHS = {"breaker.open": "░", "breaker.half_open": "▒"}
+
+
 def build_waterfall(spans, width: int) -> List[str]:
     """One row per shard; each executor-stage span paints its glyph
     over its [start, end) slice of the common timeline. Later (higher
     z) categories win inside one cell: stall over decode over fetch
     would hide work, so painting order is fetch < decode < stall —
-    overlap shows the *later* pipeline stage."""
+    overlap shows the *later* pipeline stage.
+
+    Circuit-breaker windows (``breaker.open`` / ``breaker.half_open``
+    spans, emitted when the breaker leaves each state) render as
+    shaded bands on their own per-filesystem rows below the shards —
+    dead air across every shard during an open window reads as the
+    breaker's doing, not a mystery stall."""
     by_shard: Dict[int, List[Dict[str, Any]]] = defaultdict(list)
+    breaker_rows: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
     t0, t1 = float("inf"), 0.0
     for s in spans:
         labels = s.get("labels") or {}
+        if s["name"] in _BREAKER_GLYPHS:
+            breaker_rows[str(labels.get("key", "?"))].append(s)
+            t0 = min(t0, s["ts"])
+            t1 = max(t1, s["ts"] + s["dur"])
+            continue
         if "shard" not in labels or category_of(s["name"]) is None:
             continue
         try:
@@ -192,6 +214,18 @@ def build_waterfall(spans, width: int) -> List[str]:
         rows.append(
             f"  shard {shard:>{shard_w}} |{''.join(cells)}| "
             f"{fmt_s(busy).strip()} busy")
+    for key in sorted(breaker_rows):
+        cells = [" "] * width
+        for s in sorted(breaker_rows[key], key=lambda s: s["ts"]):
+            glyph = _BREAKER_GLYPHS[s["name"]]
+            a = int((s["ts"] - t0) * scale)
+            b = max(a + 1, int((s["ts"] + s["dur"] - t0) * scale))
+            for i in range(a, min(b, width)):
+                cells[i] = glyph
+        label = f"brk {key}"[: 6 + shard_w]
+        rows.append(
+            f"  {label:<{6 + shard_w}} |{''.join(cells)}| "
+            "breaker open=░ half-open=▒")
     legend = "  " + " ".join(
         f"{g}={cat}" for cat, g, _ in CATEGORIES)
     span_line = (f"  timeline: {t1 - t0:.3f}s across "
@@ -284,9 +318,12 @@ STALL_CATEGORIES = {"emit_stall", "retry", "quarantine", "watchdog"}
 # Tie-break priority when several work buckets are live in the same
 # instant: the most downstream/specific work wins (a device kernel
 # running concurrently with a host fetch means the run is device-side
-# at that instant).
+# at that instant).  A hedge duplicate ranks below real stage work —
+# it only wins instants where nothing else is making progress — and
+# hedge-wasted time ranks last among work: it is burned concurrency,
+# attributed to its own bucket so the --analyze verdict can name it.
 WORK_PRIORITY = ("device", "transfer", "decode", "encode", "deflate",
-                 "stage", "fetch")
+                 "stage", "fetch", "hedge", "hedge_wasted")
 
 ADVICE = {
     "fetch": "I/O-bound range reads: raise executor_workers / "
@@ -306,10 +343,20 @@ ADVICE = {
              "dominate; raise prefetch_shards",
     "idle": "pipeline starved: wall-clock outside instrumented stages "
             "(driver-side gaps between runs)",
+    "hedge": "hedge duplicates dominate: the latency tail is wide — "
+             "check the store, or raise hedge_quantile/hedge_min_s",
+    "hedge_wasted": "hedge losses dominate: duplicates launch but "
+                    "rarely win; raise hedge_quantile/hedge_min_s so "
+                    "only real stragglers hedge",
 }
 
 
 def bucket_of(name: str) -> Optional[str]:
+    # Hedge-wasted time (the losing side of a hedge race) attributes
+    # to its own bucket: it is real wall-clock the hedging knob — not
+    # a pipeline stage — controls.
+    if name == "hedge.waste":
+        return "hedge_wasted"
     cat = category_of(name)
     if cat is None:
         return None

@@ -282,14 +282,19 @@ def test_chaos_soak_smoke(executor_workers):
     order becomes thread-dependent, but the recovery contract (byte
     identity / bounded loss / strict raise) must hold regardless —
     and, with --watchdog (parallel leg), the heartbeat watchdog must
-    flag the guaranteed write-side stall each iteration injects."""
+    flag the guaranteed write-side stall each iteration injects.
+    Every run also exercises the resilience legs: --hedge (duplicate
+    fetches racing a seeded slow tail, byte identity + accounting),
+    --breaker (fault storm trips / fails fast / recloses), and --kill
+    (SIGKILL a writer mid-run, ledger-asserted resume)."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
     proc = subprocess.run(
         [sys.executable, script, "--iterations", "3", "--records", "200",
          "--seed", "7", "--executor-workers", str(executor_workers),
-         "--writer-workers", str(executor_workers)]
+         "--writer-workers", str(executor_workers),
+         "--hedge", "--breaker", "--kill"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},

@@ -1,0 +1,780 @@
+"""Adaptive resilience layer (``runtime/resilience.py``): hedged shard
+fetches, per-shard deadlines, the shared retry budget, the
+per-filesystem circuit breaker, and crash-resumable reads.
+
+Acceptance contract (ISSUE 8): with seeded ``slow`` faults, hedging
+cuts the fetch-stage p99 versus hedging-off on the same schedule while
+decoded records stay byte-identical; the breaker trips within
+``breaker_window`` failures, fails fast (<10ms per rejected call)
+while open, and recloses after a successful half-open probe; the read
+ledger resumes a killed read re-running only unfinished shards; the
+disabled path creates zero threads/timers (guarded separately by
+``scripts/check_resilience.py``); and an aborted pipeline leaves no
+orphaned in-flight fetch or hedge futures.
+"""
+
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from disq_tpu import DisqOptions, ReadsStorage
+from disq_tpu.runtime.errors import (
+    BreakerOpenError,
+    DeadlineExceededError,
+    ShardRetrier,
+    TransientIOError,
+    is_transient,
+)
+from disq_tpu.runtime.resilience import (
+    CircuitBreaker,
+    HedgeController,
+    RetryBudget,
+    ShardDeadline,
+    configure_budget,
+    reset_resilience,
+    resilience_for_options,
+)
+from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+BLOCKSIZE = 600
+SPLIT = 4096
+
+
+@pytest.fixture(scope="module")
+def bam_file(tmp_path_factory):
+    records = synth_records(500, seed=7, unmapped_tail=6)
+    data = make_bam_bytes(DEFAULT_REFS, records, blocksize=BLOCKSIZE)
+    path = str(tmp_path_factory.mktemp("resbam") / "in.bam")
+    with open(path, "wb") as f:
+        f.write(data)
+    return path, records, data
+
+
+@pytest.fixture(scope="module")
+def baseline(bam_file):
+    path, _, _ = bam_file
+    return ReadsStorage.make_default().split_size(SPLIT).read(path)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    reset_resilience()
+    yield
+    reset_resilience()
+
+
+def _fault_fs(faults, seed=0):
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+
+    fsw = FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(), faults, seed=seed)
+    register_filesystem("fault", fsw)
+    return fsw
+
+
+# ---------------------------------------------------------------------------
+# hedged fetches
+# ---------------------------------------------------------------------------
+
+
+class TestHedging:
+    def _fetch_durations(self, read_fn):
+        """Run ``read_fn`` and return the executor.fetch span durations
+        it emitted."""
+        from disq_tpu.runtime import tracing
+
+        before = len(tracing.spans())
+        ds = read_fn()
+        new = tracing.spans()[before:]
+        durs = sorted(s["dur"] for s in new
+                      if s["name"] == "executor.fetch")
+        assert durs, "read emitted no fetch spans"
+        return ds, durs
+
+    # The fixture's sequential read issues a deterministic call
+    # sequence: calls 0..37 (0-based) are the header scan + boundary
+    # guesses, calls 38..56 are the 19 per-shard fetch reads (one
+    # range read each).  Slow faults targeted by call_index therefore
+    # land on *shard fetches*, where hedging can race them.
+    _FETCH_CALL_A = 40
+    _FETCH_CALL_B = 44
+
+    def test_hedging_cuts_fetch_p99_and_stays_byte_identical(
+            self, bam_file, baseline):
+        """Seeded slow tail on two shard fetches: the hedged run's
+        slowest fetch must beat the unhedged run's (the duplicate
+        escapes the injected latency — the duplicate is a NEW call and
+        draws no slow fault), and decoded records must match the
+        sequential baseline exactly."""
+        from disq_tpu.fsw import FaultSpec
+
+        path, _records, _data = bam_file
+        slow = [FaultSpec(kind="slow", path_substr="in.bam",
+                          slow_s=0.4, call_index=self._FETCH_CALL_A,
+                          times=1),
+                FaultSpec(kind="slow", path_substr="in.bam",
+                          slow_s=0.4, call_index=self._FETCH_CALL_B,
+                          times=1)]
+        # The injected latencies are pure functions of the seed: the
+        # two fires consume Random(5)'s first two draws.
+        rng = random.Random(5)
+        expected = [rng.uniform(0, 0.4), rng.uniform(0, 0.4)]
+        assert min(expected) > 0.2, "pick a seed with a real tail"
+
+        # Hedging OFF, seeded schedule.
+        _fault_fs(slow, seed=5)
+        plain_st = (ReadsStorage.make_default().split_size(SPLIT)
+                    .options(DisqOptions(max_retries=2,
+                                         retry_backoff_s=0.0)))
+        ds_plain, durs_plain = self._fetch_durations(
+            lambda: plain_st.read("fault://" + path))
+
+        # Hedging ON, identical schedule/seed rewound.
+        _fault_fs(slow, seed=5)
+        hedged_st = (ReadsStorage.make_default().split_size(SPLIT)
+                     .options(DisqOptions(max_retries=2,
+                                          retry_backoff_s=0.0)
+                              .with_hedging(0.5, 0.02)))
+        ds_hedged, durs_hedged = self._fetch_durations(
+            lambda: hedged_st.read("fault://" + path))
+
+        # p99 (here: the max — a handful of shards) must drop: the
+        # unhedged run eats the full injected tail, the hedged run
+        # escapes at the 20ms hedge threshold.
+        assert durs_plain[-1] > min(expected) * 0.9, (
+            "schedule produced no slow fetch — fixture call order "
+            f"drifted (max fetch {durs_plain[-1]:.3f}s)")
+        assert durs_hedged[-1] < durs_plain[-1] * 0.8, (
+            f"hedging did not cut the fetch tail: "
+            f"{durs_hedged[-1]:.3f}s vs {durs_plain[-1]:.3f}s")
+
+        # Byte identity all the way around.
+        for ds in (ds_plain, ds_hedged):
+            assert ds.count() == baseline.count()
+            assert np.array_equal(ds.reads.pos, baseline.reads.pos)
+            assert np.array_equal(ds.reads.names, baseline.reads.names)
+
+    def test_hedge_accounting_balances(self, bam_file, baseline):
+        from disq_tpu.fsw import FaultSpec
+        from disq_tpu.runtime.tracing import counter
+
+        path, _records, _data = bam_file
+        # Slow faults pinned to the fetch-call range (see the class
+        # comment): under executor_workers=4 the fetch order is
+        # thread-dependent, but calls >= 38 are always shard fetches
+        # (or their hedge duplicates), so at least the first slow fire
+        # hits a primary and forces a launch.
+        _fault_fs([FaultSpec(kind="slow", path_substr="in.bam",
+                             slow_s=0.3, call_index=self._FETCH_CALL_A,
+                             times=1),
+                   FaultSpec(kind="slow", path_substr="in.bam",
+                             slow_s=0.3, call_index=self._FETCH_CALL_B,
+                             times=1)], seed=3)
+        launched0 = counter("hedge.launched").total()
+        won0 = counter("hedge.won").total()
+        st = (ReadsStorage.make_default().split_size(SPLIT)
+              .hedged_fetches(0.5, 0.01).executor_workers(4))
+        ds = st.read("fault://" + path)
+        assert ds.count() == baseline.count()
+        launched = counter("hedge.launched").total() - launched0
+        won = counter("hedge.won").total() - won0
+        assert launched > 0, "no hedge launched against a 300ms tail"
+        assert launched == won
+
+    def test_hedge_controller_threshold_tracks_quantile(self):
+        h = HedgeController(quantile=0.9, min_s=0.01)
+        assert h.threshold() == pytest.approx(0.01)  # cold: the floor
+        for v in [0.001] * 90 + [0.5] * 10:
+            h.record(v)
+        # p90 over [mostly 1ms, tail 500ms] lands in the tail region.
+        assert h.threshold() >= 0.01
+        for v in [2.0] * 128:
+            h.record(v)
+        assert h.threshold() == pytest.approx(2.0)
+        h.close()
+
+    def test_hedge_survives_primary_failure(self):
+        """Primary fails transiently while the duplicate is in flight:
+        the duplicate's success must win the race."""
+        h = HedgeController(quantile=0.5, min_s=0.01)
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        def fetch():
+            with lock:
+                calls["n"] += 1
+                k = calls["n"]
+            if k == 1:
+                time.sleep(0.05)
+                raise TransientIOError("primary died slowly")
+            return b"ok"
+
+        assert h.call(fetch, shard_id=0) == b"ok"
+        h.close()
+
+    def test_hedge_both_failures_surface(self):
+        h = HedgeController(quantile=0.5, min_s=0.0)
+
+        def fetch():
+            time.sleep(0.01)
+            raise TransientIOError("storm")
+
+        with pytest.raises(TransientIOError):
+            h.call(fetch, shard_id=0)
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trips_within_window_and_recloses_after_probe(self):
+        now = [0.0]
+        br = CircuitBreaker("t", window=3, cooldown_s=5.0,
+                            clock=lambda: now[0])
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed"  # window not yet reached
+        br.record_failure()
+        assert br.state == "open"    # trips ON the window'th failure
+
+        # While open: every call rejected, and rejection is fast.
+        t0 = time.perf_counter()
+        with pytest.raises(BreakerOpenError) as ei:
+            br.before_call()
+        assert (time.perf_counter() - t0) < 0.010
+        assert ei.value.retry_after_s > 0
+
+        # Cooldown elapses: exactly one probe is admitted.
+        now[0] += 5.1
+        br.before_call()             # the probe (no raise)
+        assert br.state == "half_open"
+        with pytest.raises(BreakerOpenError):
+            br.before_call()         # concurrent caller stays rejected
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_failed_probe_reopens(self):
+        now = [0.0]
+        br = CircuitBreaker("t", window=1, cooldown_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        assert br.state == "open"
+        now[0] += 1.5
+        br.before_call()
+        assert br.state == "half_open"
+        br.record_failure()
+        assert br.state == "open"    # fresh cooldown
+        with pytest.raises(BreakerOpenError):
+            br.before_call()
+
+    def test_non_transient_probe_failure_releases_slot(self):
+        """A half-open probe that dies with a NON-transient error (404,
+        corrupt data) delivers no state-machine event — the probe slot
+        must be released, not wedge the breaker in half_open forever."""
+        now = [0.0]
+        br = CircuitBreaker("t", window=1, cooldown_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        now[0] += 1.5
+        r = ShardRetrier(max_retries=2, backoff_s=0.0, breaker=br)
+        with pytest.raises(FileNotFoundError):
+            r.call(lambda: (_ for _ in ()).throw(
+                FileNotFoundError("gone")))
+        assert br.state == "half_open"
+        # The slot is free again: the next caller probes and recloses.
+        r2 = ShardRetrier(max_retries=0, backoff_s=0.0, breaker=br)
+        assert r2.call(lambda: "ok") == "ok"
+        assert br.state == "closed"
+
+    def test_silent_probe_times_out(self):
+        """A probe that never reports at all (killed thread) stops
+        blocking half_open after one cooldown."""
+        now = [0.0]
+        br = CircuitBreaker("t", window=1, cooldown_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        now[0] += 1.5
+        br.before_call()             # probe admitted, never resolves
+        with pytest.raises(BreakerOpenError):
+            br.before_call()
+        now[0] += 1.1                # silent a whole cooldown
+        br.before_call()             # a new probe takes over
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_success_resets_failure_window(self):
+        br = CircuitBreaker("t", window=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()          # consecutive count resets
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_retrier_feeds_breaker_and_fails_fast(self, bam_file,
+                                                  baseline):
+        """End-to-end: a read through a storm trips the per-filesystem
+        breaker, a second read fails fast while open, and after the
+        cooldown a clean probe recloses it byte-identically."""
+        from disq_tpu.fsw import FaultSpec
+        from disq_tpu.runtime.resilience import breakers_snapshot
+
+        path, _records, _data = bam_file
+        fsw = _fault_fs([FaultSpec(kind="transient", probability=1.0)])
+        st = (ReadsStorage.make_default().split_size(SPLIT)
+              .options(DisqOptions(max_retries=8, retry_backoff_s=0.0)
+                       .with_breaker(3, cooldown_s=0.2)))
+        with pytest.raises(BreakerOpenError):
+            st.read("fault://" + path)
+        assert breakers_snapshot()["fault"]["state"] == "open"
+
+        t0 = time.perf_counter()
+        with pytest.raises(BreakerOpenError):
+            st.read("fault://" + path)
+        assert time.perf_counter() - t0 < 0.25  # no I/O, no backoff
+
+        fsw.faults.clear()
+        time.sleep(0.25)
+        ds = st.read("fault://" + path)
+        assert breakers_snapshot()["fault"]["state"] == "closed"
+        assert ds.count() == baseline.count()
+        assert np.array_equal(ds.reads.pos, baseline.reads.pos)
+
+    def test_breaker_open_is_not_transient(self):
+        assert not is_transient(BreakerOpenError("x", key="k"))
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_dry_bucket_denies_retries(self):
+        budget = configure_budget(2, refill_per_success=0.0)
+        assert budget is not None
+        sleeps = []
+        r = ShardRetrier(max_retries=10, backoff_s=0.01,
+                         sleep=sleeps.append)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise TransientIOError("flaky")
+
+        with pytest.raises(TransientIOError):
+            r.call(fn)
+        # 1 initial + 2 budgeted retries, NOT 1 + 10.
+        assert calls["n"] == 3
+        assert r.retried == 2
+        assert budget.tokens == pytest.approx(0.0)
+
+    def test_success_refills_proportionally(self):
+        budget = RetryBudget(capacity=10, refill_per_success=0.5)
+        for _ in range(10):
+            assert budget.try_spend()
+        assert not budget.try_spend()
+        budget.on_success()
+        budget.on_success()          # 2 successes -> 1 token
+        assert budget.try_spend()
+        assert not budget.try_spend()
+
+    def test_budget_caps_at_capacity(self):
+        budget = RetryBudget(capacity=3, refill_per_success=5.0)
+        budget.on_success()
+        assert budget.tokens == 3.0
+
+    def test_unconfigured_budget_costs_nothing(self):
+        """Default path: ShardRetrier.call with no budget behaves as
+        before (bounded by max_retries only)."""
+        r = ShardRetrier(max_retries=2, backoff_s=0.0)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise TransientIOError("flaky")
+
+        with pytest.raises(TransientIOError):
+            r.call(fn)
+        assert calls["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# backoff jitter
+# ---------------------------------------------------------------------------
+
+
+class TestDecorrelatedJitter:
+    def _sleeps(self, seed, n=4):
+        sleeps = []
+        r = ShardRetrier(max_retries=n, backoff_s=0.05,
+                         sleep=sleeps.append, rng=random.Random(seed))
+        with pytest.raises(TransientIOError):
+            r.call(lambda: (_ for _ in ()).throw(
+                TransientIOError("flaky")))
+        return sleeps
+
+    def test_seeded_and_bounded(self):
+        a = self._sleeps(1)
+        b = self._sleeps(1)
+        assert a == b                       # injectable seed ⇒ exact replay
+        cap = 0.05 * 2 ** 4
+        for s in a:
+            assert 0.05 <= s <= cap
+
+    def test_workers_decorrelate(self):
+        """Two retriers with different seeds must not sleep in
+        lockstep — the old ``backoff * 2**attempt`` schedule did."""
+        assert self._sleeps(1) != self._sleeps(2)
+
+    def test_zero_backoff_stays_zero(self):
+        sleeps = []
+        r = ShardRetrier(max_retries=3, backoff_s=0.0,
+                         sleep=sleeps.append)
+        with pytest.raises(TransientIOError):
+            r.call(lambda: (_ for _ in ()).throw(
+                TransientIOError("flaky")))
+        assert sleeps == [0.0, 0.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# per-shard deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestShardDeadline:
+    def test_escalation_ladder_clock(self):
+        now = [0.0]
+        dl = ShardDeadline(10.0, shard_id=4, clock=lambda: now[0])
+        dl.arm()
+        assert not dl.should_force_hedge() and not dl.exceeded()
+        now[0] = 5.0
+        assert dl.should_force_hedge() and not dl.exceeded()
+        now[0] = 10.0
+        with pytest.raises(DeadlineExceededError) as ei:
+            dl.check()
+        assert ei.value.shard_id == 4
+        assert not is_transient(ei.value)
+
+    def test_retrier_stops_at_deadline(self):
+        now = [0.0]
+        r = ShardRetrier(max_retries=10, backoff_s=0.0)
+        r.deadline = ShardDeadline(5.0, shard_id=1, clock=lambda: now[0])
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            now[0] += 3.0            # each attempt burns 3s of budget
+            raise TransientIOError("flaky")
+
+        with pytest.raises(DeadlineExceededError):
+            r.call(fn)
+        assert calls["n"] == 2       # 3s ok, 6s > 5s: no third attempt
+
+    def test_skip_policy_quarantines_over_deadline_shard(
+            self, bam_file, baseline):
+        """End-to-end: one shard's fetch outlives ``shard_deadline_s``;
+        under skip policy the shard is set aside as an empty batch
+        (booked ``kind="shard deadline"``) and the read completes with
+        bounded loss instead of aborting."""
+        from disq_tpu.fsw import FaultSpec
+        from disq_tpu.runtime.tracing import counter
+
+        path, _records, _data = bam_file
+        # One fixed 300ms stall on a shard-fetch call (index 40 — see
+        # TestHedging's call-map comment): with a 150ms shard deadline
+        # that shard must escalate to its fallback.
+        _fault_fs([FaultSpec(kind="stall", path_substr="in.bam",
+                             stall_s=0.3, call_index=40, times=1)])
+        skipped0 = counter("errors.skipped_blocks").value(
+            kind="shard deadline")
+        st = (ReadsStorage.make_default().split_size(SPLIT)
+              .options(DisqOptions(error_policy="skip", max_retries=1,
+                                   retry_backoff_s=0.0)
+                       .with_shard_deadline(0.15)))
+        ds = st.read("fault://" + path)
+        skipped = counter("errors.skipped_blocks").value(
+            kind="shard deadline") - skipped0
+        assert skipped == 1
+        # Bounded loss: exactly one shard's records are gone.
+        assert 0 < baseline.count() - ds.count() < baseline.count()
+
+    def test_strict_policy_aborts_on_deadline(self, bam_file):
+        from disq_tpu.fsw import FaultSpec
+
+        path, _records, _data = bam_file
+        _fault_fs([FaultSpec(kind="stall", path_substr="in.bam",
+                             stall_s=0.3, call_index=40, times=1)])
+        st = (ReadsStorage.make_default().split_size(SPLIT)
+              .options(DisqOptions(max_retries=1, retry_backoff_s=0.0)
+                       .with_shard_deadline(0.1)))
+        with pytest.raises(DeadlineExceededError):
+            st.read("fault://" + path)
+
+
+# ---------------------------------------------------------------------------
+# crash-resumable reads (ReadLedger)
+# ---------------------------------------------------------------------------
+
+
+class TestReadLedger:
+    def test_crashed_read_resumes_only_unfinished_shards(
+            self, bam_file, baseline, tmp_path):
+        from disq_tpu.fsw import (
+            PosixFileSystemWrapper,
+            register_filesystem,
+        )
+        from disq_tpu.runtime.manifest import ReadLedger
+
+        path, _records, _data = bam_file
+        ledger_dir = str(tmp_path / "ledger")
+
+        # Crash mid-read: the 43rd range read is shard 4's fetch (see
+        # TestHedging's call-map comment — 38 header/boundary calls,
+        # then one fetch per shard), so shards 0..3 emit and spill,
+        # then the process "dies".
+        class _Poison(PosixFileSystemWrapper):
+            def __init__(self):
+                self.reads = 0
+                self.poisoned = True
+
+            def read_range(self, p, start, length):
+                self.reads += 1
+                if self.poisoned and self.reads == 43:
+                    raise RuntimeError("simulated crash")
+                return super().read_range(p, start, length)
+
+        from disq_tpu.fsw import FaultInjectingFileSystemWrapper
+
+        fs = _Poison()
+        # Route through the (empty) fault wrapper for scheme stripping
+        # and the same read_range-routed open() the call map assumes.
+        register_filesystem("fault",
+                            FaultInjectingFileSystemWrapper(fs, []))
+        st = (ReadsStorage.make_default().split_size(SPLIT)
+              .options(DisqOptions(max_retries=0)
+                       .with_read_ledger(ledger_dir)))
+        with pytest.raises(RuntimeError):
+            st.read("fault://" + path)
+
+        lg = ReadLedger(ledger_dir)   # params=None: inspect as-is
+        done = lg.completed_shards()
+        assert done == [0, 1, 2, 3], done
+
+        # Resume: finished shards come from spills — their fetch reads
+        # never re-issue — and the result matches the baseline.
+        fs.poisoned = False
+        fs.reads = 0
+        crashed_reads_per_shard = 1   # one range read per shard fetch
+        ds = st.read("fault://" + path)
+        full_read_calls = 38 + 19     # header/boundary + every shard
+        assert fs.reads == full_read_calls - 4 * crashed_reads_per_shard
+        assert ds.count() == baseline.count()
+        assert np.array_equal(ds.reads.pos, baseline.reads.pos)
+        assert np.array_equal(ds.reads.names, baseline.reads.names)
+
+        # Commit point reached: ledger cleaned for the next run.
+        assert not os.path.exists(lg.manifest.path)
+        assert not ReadLedger(ledger_dir).completed_shards()
+
+    def test_param_mismatch_resets_ledger(self, tmp_path):
+        from disq_tpu.runtime.manifest import ReadLedger
+
+        d = str(tmp_path / "lg")
+        a = ReadLedger(d, params={"path": "x", "shards": 4})
+        a.record(0, "payload")
+        assert ReadLedger(d, params={"path": "x", "shards": 4}).is_done(0)
+        assert not ReadLedger(d, params={"path": "y", "shards": 4}
+                              ).is_done(0)
+
+    def test_decode_affecting_options_reset_ledger(self, tmp_path):
+        """A resume under options that change what a shard decodes to
+        (policy, deadline) must reset the ledger, never serve spills
+        recorded under the old semantics."""
+        from disq_tpu.runtime.executor import read_ledger_for_storage
+
+        class _Storage:
+            def __init__(self, opts):
+                self._options = opts
+
+        d = str(tmp_path / "lg")
+        base = DisqOptions(error_policy="skip").with_read_ledger(d)
+        lg = read_ledger_for_storage(_Storage(base), "p", 4)
+        lg.record(0, "skip-decoded")
+        assert read_ledger_for_storage(_Storage(base), "p", 4).is_done(0)
+        strict = DisqOptions().with_read_ledger(d)
+        assert not read_ledger_for_storage(
+            _Storage(strict), "p", 4).is_done(0)
+        deadlined = base.with_shard_deadline(1.0)
+        assert not read_ledger_for_storage(
+            _Storage(deadlined), "p", 4).is_done(0)
+
+    def test_missing_spill_reruns_shard(self, tmp_path):
+        from disq_tpu.runtime.manifest import ReadLedger
+
+        d = str(tmp_path / "lg")
+        lg = ReadLedger(d)
+        lg.record(2, {"v": 1})
+        os.unlink(os.path.join(d, "shard-2.pkl"))
+        assert not lg.is_done(2)
+
+
+# ---------------------------------------------------------------------------
+# abort leaves no orphaned in-flight futures (fetch + hedge)
+# ---------------------------------------------------------------------------
+
+
+class TestAbortCancellation:
+    def _drain_threads(self, prefixes, timeout=5.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            alive = [t.name for t in threading.enumerate()
+                     if t.name.startswith(prefixes) and t.is_alive()]
+            if not alive:
+                return []
+            time.sleep(0.02)
+        return alive
+
+    def test_abort_cancels_inflight_fetches(self):
+        """First-error abort: queued fetch futures are cancelled (never
+        start), running ones finish and their pools wind down — no
+        orphaned stage work survives the abort."""
+        from disq_tpu.runtime.executor import (
+            ShardPipelineExecutor,
+            ShardTask,
+        )
+
+        release = threading.Event()
+        started = []
+
+        def make_fetch(i):
+            def fetch():
+                started.append(i)
+                if i == 0:
+                    raise ValueError("boom")
+                assert release.wait(5.0), "abort leaked a blocked fetch"
+                return i
+            return fetch
+
+        tasks = [ShardTask(shard_id=i, fetch=make_fetch(i),
+                           decode=lambda p: p) for i in range(32)]
+        ex = ShardPipelineExecutor(workers=4, prefetch_shards=6)
+        with pytest.raises(ValueError):
+            for _ in ex.map_ordered(tasks):
+                pass
+        release.set()
+        # cancel_futures: tasks beyond the admitted window never ran.
+        assert len(started) <= 12, started
+        assert not self._drain_threads(("disq-fetch", "disq-decode"))
+
+    def test_abort_cancels_hedge_duplicates(self):
+        """The hedged variant of the same contract: an abort mid-run
+        must also tear down the hedge pool — no duplicate fetch may
+        keep running after the pipeline died."""
+        from disq_tpu.runtime.executor import (
+            ShardPipelineExecutor,
+            ShardTask,
+        )
+        from disq_tpu.runtime.resilience import ResilienceManager
+
+        release = threading.Event()
+        fetches = []
+
+        def make_fetch(i):
+            def fetch():
+                fetches.append(i)
+                if i == 0:
+                    time.sleep(0.05)
+                    raise ValueError("boom")
+                # Slow enough that hedges launch against it.
+                assert release.wait(5.0), "abort leaked a hedge fetch"
+                return i
+            return fetch
+
+        tasks = [ShardTask(shard_id=i, fetch=make_fetch(i),
+                           decode=lambda p: p) for i in range(8)]
+        res = ResilienceManager(
+            hedge=HedgeController(quantile=0.5, min_s=0.01))
+        ex = ShardPipelineExecutor(workers=2, prefetch_shards=3,
+                                   resilience=res)
+        with pytest.raises(ValueError):
+            for _ in ex.map_ordered(tasks):
+                pass
+        release.set()
+        assert not self._drain_threads(
+            ("disq-fetch", "disq-decode", "disq-hedge"))
+
+    def test_inline_hedge_pool_closes_after_run(self, bam_file):
+        """The sequential (workers=1) path closes the hedge pool at the
+        end of a normal run too."""
+        path, _records, _data = bam_file
+        st = (ReadsStorage.make_default().split_size(SPLIT)
+              .hedged_fetches(0.5, 0.0))   # hedge every fetch
+        st.read(path)
+        assert not self._drain_threads(("disq-hedge",))
+
+
+# ---------------------------------------------------------------------------
+# healthz surfacing + options plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSurfacing:
+    def test_healthz_carries_budget_and_breakers(self):
+        from disq_tpu.runtime.introspect import HEALTH
+        from disq_tpu.runtime.resilience import (
+            breaker_for,
+            configure_breakers,
+        )
+
+        configure_budget(50)
+        configure_breakers(4, 1.0)
+        br = breaker_for("http://host/x")
+        doc = HEALTH.healthz()
+        assert doc["resilience"]["budget"]["capacity"] == 50
+        assert doc["resilience"]["breakers"]["http"]["state"] == "closed"
+        # An open breaker degrades the verdict.
+        for _ in range(4):
+            br.record_failure()
+        doc = HEALTH.healthz()
+        assert doc["resilience"]["breakers"]["http"]["state"] == "open"
+        assert doc["status"] == "degraded"
+
+    def test_disabled_options_build_no_manager(self):
+        assert resilience_for_options(DisqOptions()) is None
+
+    def test_option_validation(self):
+        with pytest.raises(ValueError):
+            DisqOptions().with_hedging(1.5)
+        with pytest.raises(ValueError):
+            DisqOptions().with_shard_deadline(0)
+        with pytest.raises(ValueError):
+            DisqOptions().with_retry_budget(0)
+        with pytest.raises(ValueError):
+            DisqOptions().with_breaker(0)
+
+    def test_builders_round_trip(self):
+        st = (ReadsStorage.make_default()
+              .hedged_fetches(0.9, 0.02)
+              .shard_deadline(12.0)
+              .retry_budget(100, 0.25)
+              .circuit_breaker(5, 2.0)
+              .read_ledger("/tmp/lg"))
+        o = st._options
+        assert o.hedge_quantile == 0.9 and o.hedge_min_s == 0.02
+        assert o.shard_deadline_s == 12.0
+        assert o.retry_budget_tokens == 100
+        assert o.retry_budget_refill == 0.25
+        assert o.breaker_window == 5 and o.breaker_cooldown_s == 2.0
+        assert o.read_ledger == "/tmp/lg"

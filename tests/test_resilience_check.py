@@ -1,0 +1,26 @@
+"""Tier-1 guard for the adaptive-resilience invariants
+(``scripts/check_resilience.py``): the circuit-breaker state machine is
+total over every (state, event) pair and only takes legal edges, every
+hedge launch books exactly one winner, and the disabled path (no
+resilience knob set) creates zero threads/timers and stays
+byte-identical to seed behavior."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_resilience.py")
+
+
+def test_resilience_guard_passes():
+    # fresh subprocess: the structural checks assert on process-global
+    # state (budget, breakers, threads) that other tests may have
+    # touched
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT], capture_output=True, text=True,
+        env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, (
+        f"resilience guard failed:\n{proc.stdout}{proc.stderr}")
+    assert "OK" in proc.stdout
