@@ -511,6 +511,113 @@ def device_inflate_config(path: str) -> dict:
     }
 
 
+def device_service_config(path: str) -> dict:
+    """Config 9: device inflate END-TO-END through the cross-shard
+    decode service (``runtime/device_service.py``) at simulated
+    executor widths 1 and 4, against the kernel-only ceiling — real
+    chip only.
+
+    Each worker thread plays one executor decode stage: it submits its
+    shard group's blocks via ``inflate_blocks_device`` exactly as a
+    read would with ``DISQ_TPU_DEVICE_SERVICE=1``.  The row reports
+    MB/s, the mean ``device.lane_fill`` over the row's launches (the
+    cross-shard batching win: partial per-shard chunks coalesce into
+    full 128-lane launches), and the e2e/kernel-only ratio — the
+    dispatch overhead this PR exists to close."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax.numpy as jnp
+
+    from disq_tpu.bgzf.codec import inflate_blocks_device
+    from disq_tpu.bgzf.guesser import find_block_table
+    from disq_tpu.fsw import PosixFileSystemWrapper
+    from disq_tpu.ops import inflate_simd as S
+    from disq_tpu.runtime import device_service
+    from disq_tpu.runtime.tracing import REGISTRY
+
+    fs = PosixFileSystemWrapper()
+    blocks = [b for b in find_block_table(fs, path) if b.usize > 0]
+    with open(path, "rb") as f:
+        data = f.read()
+
+    # kernel-only ceiling: pre-packed chunks, launch + sync, zero
+    # per-block host work (same protocol as the TPU CI lane)
+    mv = memoryview(data)
+    payloads, usizes = [], []
+    for b in blocks:
+        xlen = struct.unpack_from("<H", data, b.pos + 10)[0]
+        payloads.append(mv[b.pos + 12 + xlen: b.pos + b.csize - 8])
+        usizes.append(b.usize)
+    small = [i for i in range(len(payloads))
+             if len(payloads[i]) <= S.MAX_DEVICE_CSIZE]
+    total = sum(usizes[i] for i in small)
+    cw, ow = S.buckets_for([payloads[i] for i in small],
+                           max(usizes[i] for i in small))
+    fn = S._compiled(cw, ow, False)
+    consts = S._device_const_tables()
+    # pre-upload outside the timed loop (tpu_ci protocol: the ceiling
+    # isolates compute from the H2D wall — charging per-rep uploads to
+    # it would understate the ceiling and flatter the e2e ratio)
+    packed = [
+        tuple(jnp.asarray(a) for a in S._pack_chunk(
+            [payloads[i] for i in small[lo: lo + 128]], cw))
+        for lo in range(0, len(small), 128)
+    ]
+
+    def kernel_only():
+        outs = [fn(c, l, *consts) for c, l in packed]
+        for _w, m in outs:
+            np.asarray(m)
+
+    kernel_only()
+    medk, timesk = _timed(kernel_only, 3)
+    kernel_mbps = total / medk / 1e6
+
+    groups = [blocks[i::16] for i in range(16)]
+    fill = REGISTRY.gauge("device.lane_fill")
+    rows: dict = {
+        "kernel_only_mb_per_sec": round(kernel_mbps, 2),
+        "kernel_only_spread": _spread(timesk),
+    }
+    prev = os.environ.get("DISQ_TPU_DEVICE_SERVICE")
+    os.environ["DISQ_TPU_DEVICE_SERVICE"] = "1"
+    try:
+        for w in (1, 4):
+            def run(w=w):
+                with ThreadPoolExecutor(max_workers=w) as pool:
+                    list(pool.map(
+                        lambda g: inflate_blocks_device(data, g), groups))
+
+            run()
+            s0 = fill.state() or {"samples": 0, "mean": 0.0}
+            med, times = _timed(run, 3)
+            s1 = fill.state() or {"samples": 0, "mean": 0.0}
+            dn = s1["samples"] - s0["samples"]
+            dsum = s1["mean"] * s1["samples"] - s0["mean"] * s0["samples"]
+            rows[f"workers_{w}"] = {
+                "mb_per_sec": round(
+                    sum(b.usize for b in blocks) / med / 1e6, 2),
+                "spread": _spread(times),
+                "lane_fill_mean": round(dsum / dn, 3) if dn else 0.0,
+                # ratio over the SAME byte total the kernel-only row
+                # measured (device-served blocks) — oversize host-side
+                # blocks must not inflate the headline ratio
+                "e2e_vs_kernel_ratio": round(
+                    (total / med / 1e6) / kernel_mbps, 3),
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("DISQ_TPU_DEVICE_SERVICE", None)
+        else:
+            os.environ["DISQ_TPU_DEVICE_SERVICE"] = prev
+        device_service.shutdown_service()
+    return {"9_device_service_inflate": rows}
+
+
 def main() -> None:
     tmp = tempfile.mkdtemp(prefix="disq_bench_")
     path = os.path.join(tmp, "bench.bam")
@@ -566,6 +673,7 @@ def main() -> None:
     configs.update(http_read_config(path, max(2, REPS - 2)))
     configs.update(write_scaling_config(path, tmp, max(2, REPS - 2)))
     configs.update(device_inflate_config(path))
+    configs.update(device_service_config(path))
 
     # Telemetry snapshot accumulated across every config above
     # (runtime/tracing.py): phase totals + p50/p99, labeled counters

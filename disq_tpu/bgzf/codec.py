@@ -104,8 +104,10 @@ def _inflate_blocks_timed(data, blocks, base, verify_crc, as_array,
     import numpy as np
 
     if env_flag("DISQ_TPU_DEVICE_INFLATE"):
-        out = inflate_blocks_device(data, blocks, base, verify_crc=verify_crc)
-        return np.frombuffer(out, dtype=np.uint8) if as_array else out
+        # as_array flows through: the SIMD path assembles the blob
+        # straight from the kernel's transposed output (no bytes join)
+        return inflate_blocks_device(
+            data, blocks, base, verify_crc=verify_crc, as_array=as_array)
     try:
         from disq_tpu.native import inflate_blocks_native
 
@@ -132,40 +134,85 @@ def _inflate_blocks_timed(data, blocks, base, verify_crc, as_array,
 
 def inflate_blocks_device(
     data: bytes, blocks: Sequence[BgzfBlock], base: int = 0,
-    verify_crc: bool = True,
-) -> bytes:
+    verify_crc: bool = True, as_array: bool = False,
+):
     """Device path of ``inflate_blocks``: the 128-lane SIMD Pallas
     kernel (``ops/inflate_simd``, the PROBES.md design) with ISIZE
     validated against the kernel's per-lane output length and CRC on
     host. ``DISQ_TPU_DEVICE_INFLATE=legacy`` selects the round-1
-    one-block-per-grid-program kernel (``ops/inflate``) for A/B runs."""
+    one-block-per-grid-program kernel (``ops/inflate``) for A/B runs.
+
+    With ``DISQ_TPU_DEVICE_SERVICE=1`` the block batch is submitted to
+    the cross-shard decode service (``runtime/device_service.py``):
+    blocks from concurrently-decoding shards coalesce into full
+    128-lane launches, and the decoded bytes land in one contiguous
+    blob with no per-block ``bytes`` round-trips.  Payloads are sliced
+    as ``memoryview``\\ s on the SIMD paths (nothing here copies the
+    compressed bytes); batch CRC verification runs threaded, off the
+    kernel's critical path (the service keeps decoding other shards'
+    chunks while this thread verifies).  ``as_array`` returns the blob
+    as a uint8 array instead of bytes."""
     import os
 
-    if os.environ.get("DISQ_TPU_DEVICE_INFLATE", "").lower() == "legacy":
-        from disq_tpu.ops.inflate import inflate_payloads
-    else:
-        from disq_tpu.ops.inflate_simd import (
-            inflate_payloads_simd as inflate_payloads,
-        )
+    import numpy as np
 
     if not blocks:
-        return b""
+        return np.empty(0, dtype=np.uint8) if as_array else b""
+    legacy = os.environ.get(
+        "DISQ_TPU_DEVICE_INFLATE", "").lower() == "legacy"
+    mv = memoryview(data)
     payloads = []
     for b in blocks:
         off = b.pos - base
         xlen = struct.unpack_from("<H", data, off + 10)[0]
-        payloads.append(
-            data[off + 12 + xlen: off + b.csize - BGZF_FOOTER_SIZE]
-        )
-    parts = inflate_payloads(payloads, usizes=[b.usize for b in blocks])
+        p = mv[off + 12 + xlen: off + b.csize - BGZF_FOOTER_SIZE]
+        payloads.append(bytes(p) if legacy else p)
+    usizes = [b.usize for b in blocks]
+    if legacy:
+        from disq_tpu.ops.inflate import inflate_payloads
+        from disq_tpu.ops.inflate_simd import assemble_blob
+
+        blob, offsets = assemble_blob(
+            inflate_payloads(payloads, usizes=usizes))
+    else:
+        from disq_tpu.runtime import device_service
+
+        if device_service.enabled():
+            blob, offsets = device_service.get_service().submit_inflate(
+                payloads, usizes).result()
+        else:
+            from disq_tpu.ops.inflate_simd import inflate_payloads_simd
+
+            blob, offsets = inflate_payloads_simd(
+                payloads, usizes=usizes, as_array=True)
     if verify_crc:
-        for i, (b, part) in enumerate(zip(blocks, parts)):
-            crc = struct.unpack_from(
-                "<I", data, b.pos - base + b.csize - BGZF_FOOTER_SIZE
-            )[0]
-            if zlib.crc32(part) != crc:
-                raise ValueError(f"BGZF CRC mismatch at block {i}")
-    return b"".join(parts)
+        _verify_block_crcs(data, blocks, base, blob, offsets)
+    return blob if as_array else blob.tobytes()
+
+
+def _verify_block_crcs(data, blocks, base, blob, offsets) -> None:
+    """Batch CRC check of device-decoded output against the BGZF
+    footers, over zero-copy blob slices (no per-block bytes).  Big
+    batches fan out over the shared pool — ``zlib.crc32`` releases the
+    GIL, so with the decode service on, one shard's verification
+    overlaps the dispatcher's next chunks instead of serializing the
+    whole queue behind it."""
+
+    def check(i: int) -> None:
+        b = blocks[i]
+        crc = struct.unpack_from(
+            "<I", data, b.pos - base + b.csize - BGZF_FOOTER_SIZE)[0]
+        if zlib.crc32(blob[int(offsets[i]): int(offsets[i + 1])]) != crc:
+            raise ValueError(f"BGZF CRC mismatch at block {i}")
+
+    if len(blocks) >= 32:
+        from disq_tpu.util import shared_host_pool
+
+        for _ in shared_host_pool().map(check, range(len(blocks))):
+            pass
+    else:
+        for i in range(len(blocks)):
+            check(i)
 
 
 def deflate_blob(blob: bytes) -> tuple[bytes, "np.ndarray"]:

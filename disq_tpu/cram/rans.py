@@ -256,6 +256,18 @@ def rans_decode(data: bytes) -> bytes:
 
             return rans0_decode_device([data])[0]
         if env_flag("DISQ_TPU_DEVICE_RANS"):
+            from disq_tpu.runtime import device_service
+
+            if device_service.enabled():
+                # cross-shard lane batching: this stream coalesces with
+                # other decode workers' streams into full 128-lane
+                # launches (runtime/device_service.py).  NOTE: with a
+                # single decode worker there is nothing to coalesce
+                # with, and every lone stream pays the batcher's flush
+                # timeout — the service flag is for executor_workers>1
+                # runs; leave it off for sequential decode.
+                return device_service.get_service().submit_rans(
+                    [data]).result()[0]
             # 128-lane SIMD kernel path: disq_tpu.ops.rans_simd.
             from disq_tpu.ops.rans_simd import rans0_decode_simd
 

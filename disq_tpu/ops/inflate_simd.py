@@ -51,7 +51,9 @@ distance · 5 output overflow · 6 ran past the compressed payload ·
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -74,6 +76,7 @@ from disq_tpu.runtime.tracing import (
     count_transfer as _count_transfer,
     counter as _counter,
     device_span as _device_span,
+    gauge as _gauge,
     track_hbm as _track_hbm,
 )
 
@@ -823,8 +826,9 @@ def _inflate_simd_kernel(
          jnp.zeros((1, LANES), _I32)], axis=0)
 
 
-@functools.lru_cache(maxsize=8)
-def _compiled(cw: int, ow: int, interpret: bool):
+@functools.lru_cache(maxsize=16)
+def _compiled(cw: int, ow: int, interpret: bool,
+              transpose: bool = False, donate: bool = False):
     # emits bound one term; non-emitting supersteps (headers, table
     # builds, dist phases) consume >= 3 input bits each, so cw bounds
     # the other — flush-heavy many-small-block streams stay on device
@@ -861,7 +865,26 @@ def _compiled(cw: int, ow: int, interpret: bool):
         ],
         interpret=interpret,
     )
-    return jax.jit(call)
+    if transpose:
+        inner = call
+
+        def call(*args):
+            # lanes-major words: ONE device-side transpose makes every
+            # lane's output bytes host-contiguous, so unpack is a view
+            # per lane instead of a strided per-lane gather + tobytes
+            words, meta = inner(*args)
+            return jnp.transpose(words), meta
+
+    nums: Tuple[int, ...] = ()
+    if donate and not interpret:
+        # donate the comp upload only when its buffer can actually
+        # back the words output (same shape+dtype) — donating args the
+        # runtime cannot alias buys nothing and makes jax warn into
+        # every importer's process; clen (1,128) never matches meta
+        out_words = (LANES, ow) if transpose else (ow, LANES)
+        if (cw, LANES) == out_words:
+            nums = (0,)
+    return jax.jit(call, donate_argnums=nums)
 
 
 def _bucket(n: int, lo: int = 64) -> int:
@@ -871,17 +894,147 @@ def _bucket(n: int, lo: int = 64) -> int:
     return b
 
 
-def _pack_chunk(chunk: Sequence[bytes], cw: int):
+# ---------------------------------------------------------------------------
+# Host staging arenas, device-resident constant tables, adaptive window
+# ---------------------------------------------------------------------------
+
+
+class _PackArena:
+    """Reusable host staging buffers for one <=128-lane chunk launch.
+
+    ``_pack_chunk`` writes payload bytes in place instead of allocating
+    a fresh zeroed (cw,128) buffer per chunk; ``dirty`` tracks each
+    lane's written-word high-water mark so reuse zeroes only the stale
+    tail, not the whole 4 MB column buffer. ``extras`` carries
+    codec-specific lane tables (the rANS freq/cum/state arrays)."""
+
+    def __init__(self, cw: int):
+        self.cw = cw
+        self.comp = np.zeros((cw, LANES), dtype="<u4")
+        self.clen = np.zeros((1, LANES), dtype=np.int32)
+        self.dirty = np.zeros(LANES, dtype=np.int64)
+        self.extras: Dict[str, np.ndarray] = {}
+
+    @property
+    def nbytes(self) -> int:
+        return (self.comp.nbytes + self.clen.nbytes + self.dirty.nbytes
+                + sum(a.nbytes for a in self.extras.values()))
+
+
+class _ArenaPool:
+    """Process-wide checkout pool of ``_PackArena`` staging buffers,
+    keyed by (codec kind, cw bucket).  Thread-safe: concurrent decode
+    workers (or the decode service's dispatcher) check an arena out for
+    the lifetime of one chunk — pack, upload, launch, materialize — and
+    return it afterwards, so a buffer is never repacked while a launch
+    might still be reading it.  Pool size self-adjusts to the dispatch
+    window; ``device.arena_bytes`` tracks the resident total."""
+
+    def __init__(self, per_key_cap: int = 8) -> None:
+        self._lock = threading.Lock()
+        self._free: Dict[Any, List[_PackArena]] = {}
+        self._bytes = 0
+        self._cap = per_key_cap
+
+    def acquire(self, key: Any,
+                factory: Callable[[], _PackArena]) -> _PackArena:
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                return free.pop()
+        arena = factory()
+        with self._lock:
+            self._bytes += arena.nbytes
+            total = self._bytes
+        _gauge("device.arena_bytes").observe(total)
+        return arena
+
+    def release(self, key: Any, arena: _PackArena) -> None:
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if len(free) < self._cap:
+                free.append(arena)
+                return
+            self._bytes -= arena.nbytes
+            total = self._bytes
+        _gauge("device.arena_bytes").observe(total)
+
+
+ARENAS = _ArenaPool()
+
+_CONST_CACHE: Dict[Any, tuple] = {}
+_CONST_LOCK = threading.Lock()
+
+
+def _device_const_tables() -> tuple:
+    """The kernel's constant (R,128) tables as device-resident arrays,
+    uploaded ONCE per device per process.  Previously every
+    ``inflate_payloads_simd`` call re-ran ``jnp.asarray`` over all
+    seven tables — a fresh ~200 KB H2D upload per shard."""
+    dev = jax.devices()[0]
+    with _CONST_LOCK:
+        cached = _CONST_CACHE.get(dev)
+        if cached is None:
+            cached = tuple(jax.device_put(t, dev) for t in _CONST_TABLES)
+            _CONST_CACHE[dev] = cached
+    return cached
+
+
+def dispatch_window(n_chunks: int, chunk_bytes: int) -> int:
+    """Adaptive dispatch window (replaces the hard-coded ``window = 3``):
+    enough chunks in flight to overlap H2D / compute / D2H, bounded by
+    a staging-HBM budget so big (cw, ow) geometries don't pin several
+    12 MB footprints at once.  ``DISQ_TPU_DISPATCH_WINDOW`` pins the
+    width; ``DISQ_TPU_DISPATCH_HBM_MB`` resizes the budget (default
+    96 MB)."""
+    pinned = os.environ.get("DISQ_TPU_DISPATCH_WINDOW", "").strip()
+    if pinned:
+        return max(1, min(int(pinned), max(1, n_chunks)))
+    budget = int(os.environ.get("DISQ_TPU_DISPATCH_HBM_MB", "96")) << 20
+    return max(1, min(4, n_chunks, budget // max(1, chunk_bytes)))
+
+
+def _pack_chunk(chunk: Sequence, cw: int,
+                arena: Optional[_PackArena] = None):
     """Pack <=128 payloads into the kernel's (cw,128) LE word columns +
     (1,128) byte lengths. Single source of truth — the TPU CI lane's
-    kernel-only row packs with this too."""
-    comp = np.zeros((cw, LANES), dtype="<u4")
-    clen = np.zeros((1, LANES), dtype=np.int32)
+    kernel-only row packs with this too.
+
+    With an ``arena`` the columns are written in place (no fresh 4 MB
+    zeroed buffer, no per-payload pad-bytes concat) and only each
+    lane's dirty tail from the previous chunk is re-zeroed.  Payloads
+    may be ``bytes`` or ``memoryview`` — nothing here copies them."""
+    if arena is None:
+        comp = np.zeros((cw, LANES), dtype="<u4")
+        clen = np.zeros((1, LANES), dtype=np.int32)
+        dirty = None
+    else:
+        comp, clen, dirty = arena.comp, arena.clen, arena.dirty
+        clen[:] = 0
     for i, p in enumerate(chunk):
-        clen[0, i] = len(p)
-        pad = (-len(p)) % 4
-        w = np.frombuffer(p + b"\x00" * pad, dtype="<u4")
-        comp[: len(w), i] = w
+        n = len(p)
+        clen[0, i] = n
+        nw = n // 4
+        if nw:
+            comp[:nw, i] = np.frombuffer(p, dtype="<u4", count=nw)
+        used = nw
+        tail = n - nw * 4
+        if tail:
+            last = 0
+            base = nw * 4
+            for j in range(tail):
+                last |= p[base + j] << (8 * j)
+            comp[nw, i] = last
+            used = nw + 1
+        if dirty is not None:
+            if dirty[i] > used:
+                comp[used: int(dirty[i]), i] = 0
+            dirty[i] = used
+    if dirty is not None:
+        for i in range(len(chunk), LANES):
+            if dirty[i]:
+                comp[: int(dirty[i]), i] = 0
+                dirty[i] = 0
     return comp.view(np.uint32), clen
 
 
@@ -893,129 +1046,188 @@ def buckets_for(payloads: Sequence[bytes], max_u: int):
     return cw, ow
 
 
-def inflate_payloads_simd(
-    payloads: Sequence[bytes],
-    usizes: Optional[Sequence[int]] = None,
-    interpret: Optional[bool] = None,
-) -> List[bytes]:
-    """Inflate raw-DEFLATE payloads on the 128-lane SIMD kernel.
-
-    Returns the decompressed bytes per payload. Lanes that fail in-kernel
-    (nonzero status) are re-inflated with host zlib — corruption is the
-    host's problem to adjudicate, surfaced as ``ValueError`` (the
-    framework's corrupt-input contract).
-    """
+def host_inflate(p, expect: Optional[int] = None) -> bytes:
+    """Host-zlib fallback for one raw-DEFLATE payload, with the
+    framework's corrupt-input contract: decode failure and genuine
+    ISIZE mismatch (error 8) both surface as ``ValueError`` —
+    swallowing the latter would break the cumulative-usize slicing in
+    bam/source.py."""
     import zlib
 
+    try:
+        host = zlib.decompress(p, wbits=-15)
+    except zlib.error as e:
+        raise ValueError(f"corrupt DEFLATE stream: {e}") from e
+    if expect is not None and len(host) != expect:
+        raise ValueError(
+            f"device inflate failed: error 8 "
+            f"(ISIZE {expect} != {len(host)})")
+    return host
+
+
+def _fetch_chunk(handle, lanes: int):
+    """Materialize one launched chunk under the synced kernel span
+    (PROBES.md: asarray, not block_until_ready, fences) and book the
+    D2H bytes; returns the lanes-major uint8 view + the meta rows."""
+    words, meta = handle
+    with _device_span("device.kernel", kernel="inflate_simd",
+                      lanes=lanes) as fence:
+        words = np.asarray(fence.sync(words))
+        meta = np.asarray(meta)
+    _count_transfer("d2h", words.nbytes + meta.nbytes)
+    return words.view(np.uint8), meta
+
+
+def _finalize_lane(p, lanes_u8, meta, j: int, expect: Optional[int]):
+    """One lane of a materialized chunk: a zero-copy uint8 view of its
+    decoded bytes (device path), or host-fallback bytes for a lane the
+    kernel flagged; raises ``ValueError`` for truly corrupt input."""
+    n, status = int(meta[0, j]), int(meta[1, j])
+    if status != 0 or (expect is not None and n != expect):
+        last_stats["host_fallback"] += 1
+        _counter("device.host_fallback_blocks").inc(reason="flagged")
+        return host_inflate(p, expect)
+    last_stats["device_lanes"] += 1
+    return lanes_u8[j, :n]
+
+
+def assemble_blob(results: Sequence):
+    """Compact per-payload results (uint8 views / fallback bytes) into
+    one contiguous uint8 blob + (n+1,) int64 offsets with plain
+    memcpys — no intermediate ``bytes`` objects, no ``b"".join``."""
+    offsets = np.zeros(len(results) + 1, dtype=np.int64)
+    for i, r in enumerate(results):
+        offsets[i + 1] = offsets[i] + len(r)
+    blob = np.empty(int(offsets[-1]), dtype=np.uint8)
+    for i, r in enumerate(results):
+        if isinstance(r, np.ndarray):
+            blob[offsets[i]: offsets[i + 1]] = r
+        else:
+            blob[offsets[i]: offsets[i + 1]] = np.frombuffer(
+                r, dtype=np.uint8)
+    return blob, offsets
+
+
+def inflate_payloads_simd(
+    payloads: Sequence,
+    usizes: Optional[Sequence[int]] = None,
+    interpret: Optional[bool] = None,
+    as_array: bool = False,
+):
+    """Inflate raw-DEFLATE payloads on the 128-lane SIMD kernel.
+
+    Returns the decompressed bytes per payload — or, with
+    ``as_array``, one contiguous uint8 blob + (n+1,) offsets assembled
+    straight from the kernel's transposed output with zero per-lane
+    ``bytes`` round-trips.  Lanes that fail in-kernel (nonzero status)
+    are re-inflated with host zlib — corruption is the host's problem
+    to adjudicate, surfaced as ``ValueError`` (the framework's
+    corrupt-input contract).  Payloads may be ``memoryview`` slices.
+
+    Dispatch path (this PR's shape): staging arenas from the process
+    pool instead of fresh numpy buffers, device-resident constant
+    tables (``_device_const_tables``), donated per-chunk uploads, and
+    an adaptive launch window (``dispatch_window``).
+    """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if not payloads:
+    n = len(payloads)
+    if n == 0:
+        if as_array:
+            return np.empty(0, np.uint8), np.zeros(1, np.int64)
         return []
     # VMEM budget (~16 MB/core): comp (8192,128) u32 = 4 MB + out
     # (16384,128) u32 = 8 MB + tables/ring ~1.2 MB fits because the
     # out-sized ops run slab-wise (2048-row temps). Payloads over the
     # 32 KiB comp cap go to host zlib.
-    max_csize = MAX_DEVICE_CSIZE
-    big = [i for i, p in enumerate(payloads) if len(p) > max_csize]
-    if big:
-        import zlib as _z
+    results: List[Any] = [None] * n
+    # With known usizes the output layout is known up front: decoded
+    # lanes are written straight into the final blob as each chunk
+    # materializes, so no chunk's (LANES, ow*4) buffer outlives its
+    # loop iteration (holding per-lane views would pin every chunk of
+    # a large call in memory at once).
+    blob = offsets = None
+    if as_array and usizes is not None:
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.asarray([int(u) for u in usizes], np.int64),
+                  out=offsets[1:])
+        blob = np.empty(int(offsets[-1]), dtype=np.uint8)
 
-        def _host(p):
+    def emit(i: int, val) -> None:
+        if blob is not None:
+            if isinstance(val, np.ndarray):
+                blob[offsets[i]: offsets[i + 1]] = val
+            else:
+                blob[offsets[i]: offsets[i + 1]] = np.frombuffer(
+                    val, dtype=np.uint8)
+        elif as_array:
+            results[i] = val  # usizes unknown: assembled at the end
+        else:
+            results[i] = (val.tobytes()
+                          if isinstance(val, np.ndarray) else val)
+
+    small: List[int] = []
+    for i, p in enumerate(payloads):
+        if len(p) > MAX_DEVICE_CSIZE:
             last_stats["host_big"] += 1
             _counter("device.host_fallback_blocks").inc(reason="oversize")
-            try:
-                return _z.decompress(p, wbits=-15)
-            except _z.error as e:
-                raise ValueError(f"corrupt DEFLATE stream: {e}") from e
+            emit(i, host_inflate(
+                p, None if usizes is None else int(usizes[i])))
+        else:
+            small.append(i)
+    if small:
+        if usizes is not None:
+            max_u = max(int(usizes[i]) for i in small)
+        else:
+            max_u = 65536
+        cw, ow = buckets_for([payloads[i] for i in small], max_u)
+        fn = _compiled(cw, ow, bool(interpret), True, True)
+        consts = _device_const_tables()
+        chunks = [small[lo: lo + LANES]
+                  for lo in range(0, len(small), LANES)]
+        # Per-chunk device buffers live for the dispatch window; the
+        # footprint scope covers all concurrently launched chunks.
+        chunk_bytes = (cw + 1) * LANES * 4 + ow * LANES * 4 + 8 * LANES * 4
+        window = dispatch_window(len(chunks), chunk_bytes)
+        hbm_scope = min(window, len(chunks)) * chunk_bytes
+        _track_hbm(hbm_scope)
+        launched: List = []
 
-        bigset = set(big)
-        small = [p for i, p in enumerate(payloads) if i not in bigset]
-        small_us = (None if usizes is None else
-                    [u for i, u in enumerate(usizes) if i not in bigset])
-        small_out = iter(
-            inflate_payloads_simd(small, small_us, interpret=interpret))
-        return [
-            _host(p) if i in bigset else next(small_out)
-            for i, p in enumerate(payloads)
-        ]
-    max_c = max(len(p) for p in payloads)
-    if usizes is not None:
-        max_u = max(usizes) if len(usizes) else 0
-    else:
-        max_u = 65536
-    cw = _bucket((max_c + 8) // 4 + 2)
-    ow = min(_bucket(max(1, (max_u + 3) // 4)), 16384)
-    fn = _compiled(cw, ow, interpret)
+        def launch(ids):
+            arena = ARENAS.acquire(
+                ("inflate", cw), lambda: _PackArena(cw))
+            comp, clen = _pack_chunk([payloads[i] for i in ids], cw,
+                                     arena)
+            _count_transfer("h2d", comp.nbytes + clen.nbytes)
+            out = fn(jnp.asarray(comp), jnp.asarray(clen), *consts)
+            return out, arena
 
-    # pipelined dispatch: keep a small window of chunks in flight so
-    # H2D transfer, compute and D2H overlap, without holding every
-    # chunk's device buffers (~12 MB each) alive at once
-    consts = tuple(jnp.asarray(t) for t in _CONST_TABLES)
-    chunks = [payloads[lo: lo + LANES]
-              for lo in range(0, len(payloads), LANES)]
-    window = 3
-    launched: List = []
-    # Per-chunk device buffers live for the dispatch window; the
-    # footprint scope covers all concurrently launched chunks.
-    chunk_bytes = (cw + 1) * LANES * 4 + ow * LANES * 4 + 8 * LANES * 4
-    _track_hbm(min(window, len(chunks)) * chunk_bytes)
-
-    def launch(chunk):
-        comp, clen = _pack_chunk(chunk, cw)
-        _count_transfer("h2d", comp.nbytes + clen.nbytes)
-        return fn(jnp.asarray(comp), jnp.asarray(clen), *consts)
-
-    try:
-        for chunk in chunks[:window]:
-            launched.append(launch(chunk))
-
-        out: List[bytes] = []
-        for ci, chunk in enumerate(chunks):
-            lo = ci * LANES
-            words, meta = launched[ci]
-            # The materialize below is the chunk's real sync point
-            # (PROBES.md: asarray, not block_until_ready, fences) — the
-            # synced span covers the remaining kernel + D2H wait.
-            with _device_span("device.kernel", kernel="inflate_simd",
-                              lanes=len(chunk)) as fence:
-                words = np.asarray(fence.sync(words))
-                meta = np.asarray(meta)
-            _count_transfer("d2h", words.nbytes + meta.nbytes)
-            launched[ci] = None
-            if ci + window < len(chunks):
-                launched.append(launch(chunks[ci + window]))
-            out.extend(_unpack_chunk(chunk, lo, words, meta, usizes))
-    finally:
-        _track_hbm(-min(window, len(chunks)) * chunk_bytes)
-    return out
-
-
-def _unpack_chunk(chunk, lo, words, meta, usizes) -> List[bytes]:
-    """Slice one materialized chunk's lanes back into byte strings,
-    routing kernel-flagged lanes through the host-zlib fallback."""
-    import zlib
-
-    out: List[bytes] = []
-    for i, p in enumerate(chunk):
-        n, status = int(meta[0, i]), int(meta[1, i])
-        expect = None if usizes is None else int(usizes[lo + i])
-        if status != 0 or (expect is not None and n != expect):
-            last_stats["host_fallback"] += 1
-            _counter("device.host_fallback_blocks").inc(reason="flagged")
-            try:
-                host = zlib.decompress(p, wbits=-15)
-            except zlib.error as e:
-                raise ValueError(
-                    f"corrupt DEFLATE stream: {e}") from e
-            if expect is not None and len(host) != expect:
-                # genuine ISIZE mismatch (error 8) — the host path
-                # raises here too; swallowing it would break the
-                # cumulative-usize slicing in bam/source.py
-                raise ValueError(
-                    f"device inflate failed: error 8 "
-                    f"(ISIZE {expect} != {len(host)})")
-            out.append(host)
-            continue
-        last_stats["device_lanes"] += 1
-        out.append(np.ascontiguousarray(words[:, i]).tobytes()[:n])
-    return out
+        try:
+            for ids in chunks[:window]:
+                launched.append(launch(ids))
+            for ci, ids in enumerate(chunks):
+                handle, arena = launched[ci]
+                lanes_u8, meta = _fetch_chunk(handle, len(ids))
+                launched[ci] = None
+                # materialized => the upload was consumed; the arena is
+                # safe to repack for a later chunk
+                ARENAS.release(("inflate", cw), arena)
+                if ci + window < len(chunks):
+                    launched.append(launch(chunks[ci + window]))
+                for j, i in enumerate(ids):
+                    expect = None if usizes is None else int(usizes[i])
+                    emit(i, _finalize_lane(
+                        payloads[i], lanes_u8, meta, j, expect))
+        finally:
+            _track_hbm(-hbm_scope)
+            # an abandoned window (corrupt lane raised mid-loop) must
+            # still return its staging arenas — the aborted launches'
+            # results are discarded, so repacking them is safe
+            for entry in launched:
+                if entry is not None:
+                    ARENAS.release(("inflate", cw), entry[1])
+    if blob is not None:
+        return blob, offsets
+    if as_array:
+        return assemble_blob(results)
+    return results

@@ -58,14 +58,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from disq_tpu.ops.inflate_simd import (
+    ARENAS,
     LANES,
     _bucket,
     _gather,
     _gather_ref_win,
     _pack_chunk,
+    _PackArena,
     _riota,
+    dispatch_window,
 )
-from disq_tpu.runtime.tracing import counter as _counter
+from disq_tpu.runtime.tracing import (
+    count_transfer as _count_transfer,
+    counter as _counter,
+    device_span as _device_span,
+)
 
 RANS_LOW = 1 << 23
 TF_SHIFT = 12
@@ -196,8 +203,9 @@ def _rans0_simd_kernel(
     meta_ref[...] = jnp.concatenate([used, status, zrow, zrow], axis=0)
 
 
-@functools.lru_cache(maxsize=8)
-def _compiled(cw: int, ow: int, interpret: bool):
+@functools.lru_cache(maxsize=16)
+def _compiled(cw: int, ow: int, interpret: bool,
+              transpose: bool = False, donate: bool = False):
     kernel = functools.partial(_rans0_simd_kernel, cw=cw, ow=ow)
     call = pl.pallas_call(
         kernel,
@@ -212,7 +220,25 @@ def _compiled(cw: int, ow: int, interpret: bool):
         ),
         interpret=interpret,
     )
-    return jax.jit(call)
+    if transpose:
+        inner = call
+
+        def call(*args):
+            # lanes-major output — see inflate_simd._compiled
+            words, meta = inner(*args)
+            return jnp.transpose(words), meta
+
+    nums = ()
+    if donate and not interpret:
+        # donate only what the runtime can alias (see
+        # inflate_simd._compiled): states (4,128) i32 backs the meta
+        # output exactly; comp backs the words output when shapes match
+        donatable = [3]
+        out_words = (LANES, ow) if transpose else (ow, LANES)
+        if (cw, LANES) == out_words:
+            donatable.insert(0, 0)
+        nums = tuple(donatable)
+    return jax.jit(call, donate_argnums=nums)
 
 
 def _parse_stream(k: int, s: bytes):
@@ -270,20 +296,55 @@ def kernel_geometry(metas):
     return cw, ow
 
 
-def pack_lane_tables(metas, cw: int):
+def _rans_arena(cw: int) -> _PackArena:
+    """Staging arena for one rANS chunk: the shared comp/clen columns
+    plus the per-lane table arrays as reusable extras."""
+    arena = _PackArena(cw)
+    arena.extras = {
+        "raws": np.zeros((1, LANES), np.int32),
+        "states": np.zeros((4, LANES), np.int32),
+        "freq": np.zeros((256, LANES), np.int32),
+        "cum": np.zeros((257, LANES), np.int32),
+    }
+    return arena
+
+
+def pack_lane_tables(metas, cw: int, arena: Optional[_PackArena] = None):
     """Kernel input arrays for <=128 parsed streams: packed renorm
-    columns + (clen, raw, states, freq, cum) lane tables."""
-    comp, clen = _pack_chunk([m[1] for m in metas], cw)
-    raws = np.zeros((1, LANES), np.int32)
-    states = np.zeros((4, LANES), np.int32)
-    freq = np.zeros((256, LANES), np.int32)
-    cum = np.zeros((257, LANES), np.int32)
+    columns + (clen, raw, states, freq, cum) lane tables.  With an
+    ``arena`` (from ``_rans_arena``) every array is written in place;
+    stale ``raws`` are zeroed so unused lanes stay inactive (their
+    leftover state/freq/cum columns are never read as symbols — the
+    kernel masks everything on ``pos < raw``)."""
+    comp, clen = _pack_chunk([m[1] for m in metas], cw, arena)
+    if arena is None:
+        raws = np.zeros((1, LANES), np.int32)
+        states = np.zeros((4, LANES), np.int32)
+        freq = np.zeros((256, LANES), np.int32)
+        cum = np.zeros((257, LANES), np.int32)
+    else:
+        ex = arena.extras
+        raws, states, freq, cum = (
+            ex["raws"], ex["states"], ex["freq"], ex["cum"])
+        raws[:] = 0
     for i, (raw_size, _renorm, st, fr, cm) in enumerate(metas):
         raws[0, i] = raw_size
         states[:, i] = st.astype(np.int64).astype(np.int32)
         freq[:, i] = fr
         cum[:, i] = cm
     return comp, clen, raws, states, freq, cum
+
+
+def _fetch_chunk(handle, lanes: int):
+    """Materialize one launched rANS chunk under the synced kernel span
+    and book the D2H bytes; returns (lanes-major u8 view, meta)."""
+    words, meta = handle
+    with _device_span("device.kernel", kernel="rans_simd",
+                      lanes=lanes) as fence:
+        words = np.asarray(fence.sync(words))
+        meta = np.asarray(meta)
+    _count_transfer("d2h", words.nbytes + meta.nbytes)
+    return words.view(np.uint8), meta
 
 
 def rans0_decode_simd(
@@ -318,40 +379,50 @@ def rans0_decode_simd(
         return [o if o is not None else b"" for o in out]
 
     cw, ow = kernel_geometry([metas[k] for k in live])
-    fn = _compiled(cw, ow, bool(interpret))
+    fn = _compiled(cw, ow, bool(interpret), True, True)
 
     chunks = [live[lo: lo + LANES] for lo in range(0, len(live), LANES)]
-    window = 3
+    # inputs: comp + clen + raws + states + freq + cum columns
+    chunk_bytes = (cw + 1 + 1 + 4 + 256 + 257) * LANES * 4 \
+        + (ow + 4) * LANES * 4
+    window = dispatch_window(len(chunks), chunk_bytes)
     launched: List = []
 
     def launch(chunk):
-        args = pack_lane_tables([metas[k] for k in chunk], cw)
-        return fn(*(jnp.asarray(a) for a in args))
+        arena = ARENAS.acquire(("rans", cw), lambda: _rans_arena(cw))
+        args = pack_lane_tables([metas[k] for k in chunk], cw, arena)
+        _count_transfer("h2d", sum(a.nbytes for a in args))
+        return fn(*(jnp.asarray(a) for a in args)), arena
 
-    for chunk in chunks[:window]:
-        launched.append(launch(chunk))
-    # oversize streams decode on host while the first window is in
-    # flight on device
-    for k in big:
-        last_stats["host_big"] += 1
-        _counter("device.host_fallback_blocks").inc(reason="oversize")
-        out[k] = _host_decode0(streams[k])
-    for ci, chunk in enumerate(chunks):
-        words, meta = launched[ci]
-        words = np.asarray(words)
-        meta = np.asarray(meta)
-        launched[ci] = None
-        if ci + window < len(chunks):
-            launched.append(launch(chunks[ci + window]))
-        for i, k in enumerate(chunk):
-            raw_size = metas[k][0]
-            if int(meta[1, i]) != 0:
-                last_stats["host_fallback"] += 1
-                _counter("device.host_fallback_blocks").inc(
-                    reason="flagged")
-                out[k] = _host_decode0(streams[k])
-            else:
-                last_stats["device_lanes"] += 1
-                out[k] = np.ascontiguousarray(
-                    words[:, i]).tobytes()[:raw_size]
+    try:
+        for chunk in chunks[:window]:
+            launched.append(launch(chunk))
+        # oversize streams decode on host while the first window is in
+        # flight on device
+        for k in big:
+            last_stats["host_big"] += 1
+            _counter("device.host_fallback_blocks").inc(reason="oversize")
+            out[k] = _host_decode0(streams[k])
+        for ci, chunk in enumerate(chunks):
+            handle, arena = launched[ci]
+            lanes_u8, meta = _fetch_chunk(handle, len(chunk))
+            launched[ci] = None
+            ARENAS.release(("rans", cw), arena)
+            if ci + window < len(chunks):
+                launched.append(launch(chunks[ci + window]))
+            for i, k in enumerate(chunk):
+                raw_size = metas[k][0]
+                if int(meta[1, i]) != 0:
+                    last_stats["host_fallback"] += 1
+                    _counter("device.host_fallback_blocks").inc(
+                        reason="flagged")
+                    out[k] = _host_decode0(streams[k])
+                else:
+                    last_stats["device_lanes"] += 1
+                    out[k] = lanes_u8[i, :raw_size].tobytes()
+    finally:
+        # abandoned window (host fallback raised): return the arenas
+        for entry in launched:
+            if entry is not None:
+                ARENAS.release(("rans", cw), entry[1])
     return [o if o is not None else b"" for o in out]

@@ -55,6 +55,11 @@ protocol. JAX has no task retry, so the equivalents here are:
 - ``debug`` — a debug mode (``DISQ_TPU_DEBUG=1``) asserting
   shard-boundary invariants (record counts, offset monotonicity)
   after each phase.
+- ``device_service`` — the cross-shard device decode service
+  (``DISQ_TPU_DEVICE_SERVICE=1``): one dispatcher owning the device
+  queue, coalescing concurrently-decoding shards' BGZF/rANS blocks
+  into full 128-lane SIMD launches with per-shard error isolation
+  and zero-copy array-native unpack; nothing exists when disabled.
 """
 
 from disq_tpu.runtime.counters import (  # noqa: F401

@@ -1,0 +1,568 @@
+"""Cross-shard device decode service — one dispatcher owns the device
+queue and feeds the 128-lane SIMD codecs at full lane utilization.
+
+Why: the SIMD kernels decode 128 independent streams per launch, but
+the per-shard dispatch in ``bgzf/codec.py`` / ``cram/rans.py`` submits
+one shard's blocks at a time — a shard with 40 BGZF blocks launches a
+40/128-full chunk, and N executor decode workers each do so
+*concurrently*, so the device sees N partial launches instead of the
+few full ones the work actually needs (TPU_KERNELS.json: 54.2 MB/s
+kernel-only vs 17.96 MB/s end-to-end — the whole gap is host packing,
+per-chunk allocation and partial lanes).
+
+This module inverts the ownership, the way "Extending TensorFlow's
+Semantics with Pipelined Execution" overlaps producer/consumer stages:
+executor decode stages submit their shard's block batch
+(``submit_inflate`` / ``submit_rans``) and get a future back; ONE
+dispatcher thread coalesces blocks *across* in-flight shards into full
+128-lane chunks (flushing on full, on an oldest-lane timeout, or at
+drain), keeps an adaptive window of launches in flight
+(``inflate_simd.dispatch_window``), packs into pooled staging arenas,
+and writes each decoded lane straight from the kernel's transposed
+output into the owning submission's preallocated blob — zero
+intermediate ``bytes`` objects on the device path.
+
+Error isolation is strict per submission: a lane the kernel flags is
+re-inflated on host; if the host also fails (truly corrupt input) only
+the OWNER shard's future raises — lanes co-batched from other shards
+are delivered regardless.  Oversize payloads never enter the queue:
+they decode on the submitting shard's own thread, exactly like the
+per-shard dispatch did.
+
+Telemetry: ``device.lane_fill`` (lanes per launch / 128),
+``device.queue_depth``, ``device.batch.flush{reason=full|timeout|drain}``,
+``device.service.wait`` (oldest-lane queue wait per flushed chunk) and
+the arena pool's ``device.arena_bytes``.
+
+Enablement: ``DISQ_TPU_DEVICE_SERVICE=1`` — checked by the codec entry
+points alongside ``DISQ_TPU_DEVICE_INFLATE`` / ``DISQ_TPU_DEVICE_RANS``.
+Disabled (the default), no thread, queue or arena exists and the
+per-shard dispatch runs exactly as before — the zero-overhead contract
+``scripts/check_overhead.py`` guards.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from disq_tpu.runtime.tracing import (
+    counter as _counter,
+    observe_gauge as _observe_gauge,
+    record_span as _record_span,
+)
+
+LANES = 128  # mirrors ops/inflate_simd.LANES (not imported: keep this
+#              module importable without pulling jax in)
+
+
+class _Lane:
+    """One block/stream queued for a kernel lane."""
+
+    __slots__ = ("sub", "index", "payload", "expect", "ts")
+
+    def __init__(self, sub: "Submission", index: int, payload: Any,
+                 expect: int, ts: float) -> None:
+        self.sub = sub
+        self.index = index
+        self.payload = payload
+        self.expect = expect
+        self.ts = ts
+
+
+class Submission:
+    """Future for one shard's submitted batch.
+
+    Inflate submissions carry a preallocated ``blob`` + ``offsets``
+    (usizes are always known for BGZF) that lanes are written into as
+    they materialize; rANS submissions collect per-stream ``parts``.
+    The first failing owner lane records the error and releases the
+    waiter — late lanes of a failed submission are dropped."""
+
+    __slots__ = ("_event", "_lock", "_pending", "_error", "blob",
+                 "offsets", "parts")
+
+    def __init__(self, blob: Optional[np.ndarray] = None,
+                 offsets: Optional[np.ndarray] = None,
+                 parts_n: Optional[int] = None) -> None:
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.blob = blob
+        self.offsets = offsets
+        self.parts: Optional[List[Optional[bytes]]] = (
+            [None] * parts_n if parts_n is not None else None)
+        self._pending = (parts_n if parts_n is not None
+                         else len(offsets) - 1)
+        self._error: Optional[BaseException] = None
+        if self._pending == 0:
+            self._event.set()
+
+    def _store(self, index: int, value: Any) -> None:
+        if self.parts is not None:
+            self.parts[index] = (value if isinstance(value, bytes)
+                                 else bytes(value))
+        else:
+            lo = int(self.offsets[index])
+            hi = int(self.offsets[index + 1])
+            if isinstance(value, np.ndarray):
+                self.blob[lo:hi] = value
+            else:
+                self.blob[lo:hi] = np.frombuffer(value, dtype=np.uint8)
+
+    def deliver_local(self, index: int, value: Any) -> None:
+        """Pre-enqueue delivery on the submitting thread (oversize /
+        empty lanes) — no lock needed, the dispatcher can't see the
+        submission yet."""
+        self._store(index, value)
+        self._pending -= 1
+
+    def deliver(self, index: int, value: Any) -> None:
+        with self._lock:
+            if self._error is None:
+                self._store(index, value)
+            self._pending -= 1
+            if self._pending <= 0:
+                self._event.set()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+            self._pending -= 1
+            self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until every lane landed (or the first owner-lane
+        error); returns ``(blob, offsets)`` for inflate submissions,
+        the parts list for rANS ones."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("device decode service result timed out")
+        if self._error is not None:
+            raise self._error
+        if self.parts is not None:
+            return list(self.parts)
+        return self.blob, self.offsets
+
+
+class _InflateEngine:
+    """Launch/finalize hooks for BGZF raw-DEFLATE lanes, built on the
+    refactored ops/inflate_simd dispatch helpers (shared arenas,
+    device-resident const tables, transposed+donated compile).
+
+    ``host_map`` (from the owning service) fans multi-lane host-zlib
+    fallbacks out over the service's host pool so a degraded shard's
+    re-inflates don't serialize on the dispatcher thread and stall
+    every co-batched shard's queue."""
+
+    kind = "inflate"
+
+    def __init__(self, interpret: bool, host_map) -> None:
+        self._interpret = bool(interpret)
+        self._host_map = host_map
+
+    def launch(self, lanes: Sequence[_Lane]):
+        import jax.numpy as jnp
+
+        from disq_tpu.ops import inflate_simd as IS
+
+        payloads = [l.payload for l in lanes]
+        cw, ow = IS.buckets_for(
+            payloads, max(l.expect for l in lanes))
+        arena = IS.ARENAS.acquire(
+            ("inflate", cw), lambda: IS._PackArena(cw))
+        try:
+            comp, clen = IS._pack_chunk(payloads, cw, arena)
+            IS._count_transfer("h2d", comp.nbytes + clen.nbytes)
+            fn = IS._compiled(cw, ow, self._interpret, True, True)
+            out = fn(jnp.asarray(comp), jnp.asarray(clen),
+                     *IS._device_const_tables())
+        except BaseException:
+            IS.ARENAS.release(("inflate", cw), arena)
+            raise
+        return out, arena, cw
+
+    def finalize(self, handle, lanes: Sequence[_Lane]) -> None:
+        from disq_tpu.ops import inflate_simd as IS
+
+        out, arena, cw = handle
+        try:
+            lanes_u8, meta = IS._fetch_chunk(out, len(lanes))
+        finally:
+            IS.ARENAS.release(("inflate", cw), arena)
+        flagged: List[_Lane] = []
+        for j, lane in enumerate(lanes):
+            n, status = int(meta[0, j]), int(meta[1, j])
+            if status != 0 or n != lane.expect:
+                IS.last_stats["host_fallback"] += 1
+                _counter("device.host_fallback_blocks").inc(
+                    reason="flagged")
+                flagged.append(lane)
+            else:
+                IS.last_stats["device_lanes"] += 1
+                lane.sub.deliver(lane.index, lanes_u8[j, :n])
+        if flagged:
+            self._host_map(
+                flagged,
+                lambda lane: IS.host_inflate(lane.payload, lane.expect))
+
+
+class _RansEngine:
+    """Launch/finalize hooks for CRAM order-0 rANS lanes; a lane's
+    payload is ``(stream bytes, parsed meta)`` — the host-side table
+    parse already happened on the submitting thread (and raised there
+    for a corrupt header: owner-only by construction)."""
+
+    kind = "rans"
+
+    def __init__(self, interpret: bool, host_map) -> None:
+        self._interpret = bool(interpret)
+        self._host_map = host_map
+
+    def launch(self, lanes: Sequence[_Lane]):
+        import jax.numpy as jnp
+
+        from disq_tpu.ops import inflate_simd as IS
+        from disq_tpu.ops import rans_simd as RS
+
+        metas = [l.payload[1] for l in lanes]
+        cw, ow = RS.kernel_geometry(metas)
+        arena = IS.ARENAS.acquire(("rans", cw),
+                                  lambda: RS._rans_arena(cw))
+        try:
+            args = RS.pack_lane_tables(metas, cw, arena)
+            IS._count_transfer("h2d", sum(a.nbytes for a in args))
+            fn = RS._compiled(cw, ow, self._interpret, True, True)
+            out = fn(*(jnp.asarray(a) for a in args))
+        except BaseException:
+            IS.ARENAS.release(("rans", cw), arena)
+            raise
+        return out, arena, cw
+
+    def finalize(self, handle, lanes: Sequence[_Lane]) -> None:
+        from disq_tpu.ops import inflate_simd as IS
+        from disq_tpu.ops import rans_simd as RS
+
+        out, arena, cw = handle
+        try:
+            lanes_u8, meta = RS._fetch_chunk(out, len(lanes))
+        finally:
+            IS.ARENAS.release(("rans", cw), arena)
+        flagged: List[_Lane] = []
+        for j, lane in enumerate(lanes):
+            if int(meta[1, j]) != 0:
+                RS.last_stats["host_fallback"] += 1
+                _counter("device.host_fallback_blocks").inc(
+                    reason="flagged")
+                flagged.append(lane)
+            else:
+                RS.last_stats["device_lanes"] += 1
+                lane.sub.deliver(lane.index, lanes_u8[j, : lane.expect])
+        if flagged:
+            self._host_map(
+                flagged, lambda lane: RS._host_decode0(lane.payload[0]))
+
+
+class DeviceDecodeService:
+    """The dispatcher that owns the device queue (module docstring)."""
+
+    def __init__(self, flush_timeout_s: Optional[float] = None,
+                 interpret: Optional[bool] = None) -> None:
+        import os
+
+        if flush_timeout_s is None:
+            flush_timeout_s = float(
+                os.environ.get("DISQ_TPU_SERVICE_FLUSH_MS", "2")) / 1e3
+        self.flush_timeout_s = flush_timeout_s
+        if interpret is None:
+            import jax
+
+            interpret = jax.default_backend() != "tpu"
+        # outstanding fire-and-forget host-fallback lanes (drained at
+        # close so shutdown never strands a waiter); the pool itself is
+        # the process-wide disq_tpu.util.shared_host_pool
+        self._fallback_pending = 0
+        self._engines = {
+            "inflate": _InflateEngine(interpret, self._host_map),
+            "rans": _RansEngine(interpret, self._host_map),
+        }
+        self._cond = threading.Condition()
+        self._queues: Dict[str, Deque[_Lane]] = {
+            "inflate": deque(), "rans": deque()}
+        self._inflight: Deque[Tuple[str, Any, List[_Lane]]] = deque()
+        self._closed = False
+        # window sized for the standard full-BGZF geometry; the env
+        # knobs in dispatch_window apply here too
+        from disq_tpu.ops.inflate_simd import dispatch_window
+
+        self._window = dispatch_window(4, 16 << 20)
+        self._thread = threading.Thread(
+            target=self._run, name="disq-device-dispatch", daemon=True)
+        self._thread.start()
+
+    # -- submission ---------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._closed
+
+    def submit_inflate(self, payloads: Sequence,
+                       usizes: Sequence[int]) -> Submission:
+        """Submit one shard's raw-DEFLATE block batch; the result is
+        ``(blob, offsets)`` — decoded bytes of every block, contiguous
+        in submission order.  Oversize blocks decode on THIS thread
+        (host zlib), exactly like the per-shard dispatch."""
+        from disq_tpu.ops import inflate_simd as IS
+
+        n = len(payloads)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.asarray([int(u) for u in usizes], np.int64),
+                  out=offsets[1:])
+        sub = Submission(blob=np.empty(int(offsets[-1]), np.uint8),
+                         offsets=offsets)
+        lanes: List[_Lane] = []
+        for i, p in enumerate(payloads):
+            if len(p) > IS.MAX_DEVICE_CSIZE:
+                IS.last_stats["host_big"] += 1
+                _counter("device.host_fallback_blocks").inc(
+                    reason="oversize")
+                sub.deliver_local(i, IS.host_inflate(p, int(usizes[i])))
+            else:
+                # ts stamped at enqueue (see _enqueue)
+                lanes.append(_Lane(sub, i, p, int(usizes[i]), 0.0))
+        self._enqueue("inflate", lanes, sub)
+        return sub
+
+    def submit_rans(self, streams: Sequence[bytes]) -> Submission:
+        """Submit order-0 rANS streams; the result is the per-stream
+        decoded bytes list.  Header parse / oversize fallbacks run on
+        THIS thread (owner-only errors by construction)."""
+        from disq_tpu.ops import rans_simd as RS
+
+        n = len(streams)
+        sub = Submission(parts_n=n)
+        lanes: List[_Lane] = []
+        for k, s in enumerate(streams):
+            meta = RS._parse_stream(k, s)
+            if meta is None:
+                sub.deliver_local(k, b"")
+                continue
+            if (len(meta[1]) > RS.MAX_DEVICE_CSIZE
+                    or meta[0] > RS.MAX_DEVICE_RAW):
+                RS.last_stats["host_big"] += 1
+                _counter("device.host_fallback_blocks").inc(
+                    reason="oversize")
+                sub.deliver_local(k, RS._host_decode0(s))
+                continue
+            lanes.append(_Lane(sub, k, (s, meta), meta[0], 0.0))
+        self._enqueue("rans", lanes, sub)
+        return sub
+
+    def _enqueue(self, kind: str, lanes: List[_Lane],
+                 sub: Submission) -> None:
+        # stamp the flush clock HERE, not at submission start: oversize
+        # host decode / rANS table parsing on the submitting thread can
+        # take longer than the flush timeout, and pre-aged lanes would
+        # flush immediately at partial fill — defeating the coalescing
+        # this queue exists for
+        now = time.perf_counter()
+        for lane in lanes:
+            lane.ts = now
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("device decode service is closed")
+            self._queues[kind].extend(lanes)
+            depth = sum(len(q) for q in self._queues.values())
+            if sub._pending <= 0:
+                sub._event.set()
+            self._cond.notify_all()
+        _observe_gauge("device.queue_depth", depth)
+
+    def _host_map(self, lanes: List[_Lane], fn) -> None:
+        """Deliver host-fallback lanes, fanning multi-lane work over
+        the process-wide host pool so a degraded shard's re-decodes
+        don't serialize the dispatcher (and stall co-batched shards); a
+        host failure fails ONLY the owner submission."""
+
+        def one(lane: _Lane) -> None:
+            try:
+                val = fn(lane)
+            except Exception as e:  # noqa: BLE001 — owner-only
+                lane.sub.fail(e)
+            else:
+                lane.sub.deliver(lane.index, val)
+
+        if len(lanes) <= 1:
+            for lane in lanes:
+                one(lane)
+            return
+        from disq_tpu.util import shared_host_pool
+
+        def tracked(lane: _Lane) -> None:
+            try:
+                one(lane)
+            finally:
+                with self._cond:
+                    self._fallback_pending -= 1
+                    self._cond.notify_all()
+
+        # fire-and-forget: each lane delivers (or fails its owner) from
+        # the pool; the dispatcher goes straight back to launching
+        with self._cond:
+            self._fallback_pending += len(lanes)
+        pool = shared_host_pool()
+        for lane in lanes:
+            pool.submit(tracked, lane)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain the queue (remaining partial chunks flush with
+        ``reason=drain``), wait out any in-flight host-fallback lanes,
+        and stop the dispatcher."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._fallback_pending <= 0, timeout)
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException as e:  # noqa: BLE001 — fail pending, not hang
+            self._abort_all(e)
+
+    def _loop(self) -> None:
+        while True:
+            chunk = None
+            with self._cond:
+                while True:
+                    chunk = self._take_chunk_locked()
+                    if chunk is not None:
+                        break
+                    if self._inflight:
+                        break  # overlap the wait with a materialize
+                    if self._closed:
+                        return
+                    self._cond.wait(self._wait_s_locked())
+            if chunk is not None:
+                kind, lanes, reason = chunk
+                entry = self._launch(kind, lanes, reason)
+                if entry is not None:
+                    self._inflight.append(entry)
+            if self._inflight and (chunk is None
+                                   or len(self._inflight) >= self._window):
+                self._materialize(self._inflight.popleft())
+
+    def _take_chunk_locked(self):
+        now = time.perf_counter()
+        # oldest-lane-first across kinds: a sustained full-chunk burst
+        # on one codec must not starve the other queue's lanes past
+        # their flush deadline
+        for kind in sorted(
+                (k for k, q in self._queues.items() if q),
+                key=lambda k: self._queues[k][0].ts):
+            q = self._queues[kind]
+            if len(q) >= LANES:
+                lanes = [q.popleft() for _ in range(LANES)]
+                reason = "full"
+            elif self._closed or (now - q[0].ts) >= self.flush_timeout_s:
+                lanes = list(q)
+                q.clear()
+                reason = "drain" if self._closed else "timeout"
+            else:
+                continue
+            return kind, lanes, reason
+        return None
+
+    def _wait_s_locked(self) -> Optional[float]:
+        now = time.perf_counter()
+        waits = [
+            self.flush_timeout_s - (now - q[0].ts)
+            for q in self._queues.values() if q
+        ]
+        if not waits:
+            return None  # nothing queued: sleep until a notify
+        return max(1e-3, min(waits))
+
+    def _launch(self, kind: str, lanes: List[_Lane], reason: str):
+        _counter("device.batch.flush").inc(reason=reason)
+        _observe_gauge("device.lane_fill", len(lanes) / LANES)
+        _observe_gauge(
+            "device.queue_depth",
+            sum(len(q) for q in self._queues.values()))
+        _record_span("device.service.wait",
+                     time.perf_counter() - min(l.ts for l in lanes),
+                     kind=kind, lanes=len(lanes))
+        try:
+            handle = self._engines[kind].launch(lanes)
+        except BaseException as e:  # noqa: BLE001 — owners, not the loop
+            for lane in lanes:
+                lane.sub.fail(e)
+            return None
+        return kind, handle, lanes
+
+    def _materialize(self, entry) -> None:
+        kind, handle, lanes = entry
+        try:
+            self._engines[kind].finalize(handle, lanes)
+        except BaseException as e:  # noqa: BLE001 — owners, not the loop
+            for lane in lanes:
+                lane.sub.fail(e)
+
+    def _abort_all(self, exc: BaseException) -> None:
+        with self._cond:
+            self._closed = True
+            pending = [l for q in self._queues.values() for l in q]
+            for q in self._queues.values():
+                q.clear()
+            inflight = list(self._inflight)
+            self._inflight.clear()
+        for _kind, _handle, lanes in inflight:
+            pending.extend(lanes)
+        for lane in pending:
+            lane.sub.fail(exc)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton (lazy — the disabled path touches none of this)
+# ---------------------------------------------------------------------------
+
+_SERVICE: Optional[DeviceDecodeService] = None
+_SERVICE_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """True when ``DISQ_TPU_DEVICE_SERVICE`` is set truthy — the codec
+    entry points then route device decode through the shared service."""
+    from disq_tpu.runtime.debug import env_flag
+
+    return env_flag("DISQ_TPU_DEVICE_SERVICE")
+
+
+def get_service() -> DeviceDecodeService:
+    """The process-wide service, created on first use."""
+    global _SERVICE
+    with _SERVICE_LOCK:
+        if _SERVICE is None or not _SERVICE.alive:
+            _SERVICE = DeviceDecodeService()
+        return _SERVICE
+
+
+def service_if_running() -> Optional[DeviceDecodeService]:
+    """The live service or None — NEVER creates one (the overhead
+    guard asserts this stays None on the default path)."""
+    return _SERVICE
+
+
+def shutdown_service() -> None:
+    global _SERVICE
+    with _SERVICE_LOCK:
+        service, _SERVICE = _SERVICE, None
+    if service is not None:
+        service.close()

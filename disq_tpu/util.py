@@ -2,6 +2,31 @@
 
 from __future__ import annotations
 
+import threading
+
+_HOST_POOL = None
+_HOST_POOL_LOCK = threading.Lock()
+
+
+def shared_host_pool():
+    """The process-wide helper ThreadPoolExecutor for short GIL-released
+    host work on device decode paths (batch CRC verification, kernel
+    host-zlib fallback lanes).  Created lazily on first use — the
+    default/host path never touches it — and never shut down (stdlib
+    joins idle workers at interpreter exit).  ONE pool, min(4, cpus)
+    threads, shared by every caller, instead of per-call or per-module
+    singletons."""
+    global _HOST_POOL
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    with _HOST_POOL_LOCK:
+        if _HOST_POOL is None:
+            _HOST_POOL = ThreadPoolExecutor(
+                max_workers=min(4, os.cpu_count() or 1),
+                thread_name_prefix="disq-hostwork")
+        return _HOST_POOL
+
 
 def resolve_num_shards(storage) -> int:
     """Shard count for write paths: the storage's ``num_shards`` override,

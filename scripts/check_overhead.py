@@ -82,10 +82,24 @@ def main() -> int:
         errors.append("introspection endpoint running with no knob set")
     bad_threads = [
         t.name for t in threading.enumerate()
-        if t.name.startswith(("disq-watchdog", "disq-introspect"))
+        if t.name.startswith(
+            ("disq-watchdog", "disq-introspect", "disq-device",
+             "disq-hostwork"))
     ]
     if bad_threads:
         errors.append(f"stray observability threads: {bad_threads}")
+
+    # -- 1b. device decode service: disabled ⇒ no thread, no queue -----------
+    from disq_tpu.runtime import device_service
+
+    if device_service.enabled():
+        errors.append(
+            "DISQ_TPU_DEVICE_SERVICE leaked into the guard's env — the "
+            "default path must not route decode through the service")
+    if device_service.service_if_running() is not None:
+        errors.append(
+            "device decode service instantiated with no flag set — the "
+            "disabled path must spawn zero dispatcher threads")
 
     # -- 2. timing: per-shard inline-executor overhead -----------------------
     sink = []

@@ -74,6 +74,10 @@ CATEGORIES = (
     # kernel execution and explicit h2d/d2h transfer phases.
     ("device", "K", ("device.kernel",)),
     ("transfer", "T", ("device.transfer",)),
+    # Decode-service queue wait (runtime/device_service.py): the
+    # oldest-lane wait of each flushed chunk — lanes sitting batched
+    # before their kernel launched.
+    ("service_wait", "w", ("device.service.wait",)),
     # Hedged duplicate fetches (runtime/resilience.py): the duplicate's
     # own execution (hedge.fetch) and the loser's burned time
     # (hedge.waste) both paint H — a hedge racing its primary is
@@ -323,7 +327,11 @@ STALL_CATEGORIES = {"emit_stall", "retry", "quarantine", "watchdog"}
 # hedge-wasted time ranks last among work: it is burned concurrency,
 # attributed to its own bucket so the --analyze verdict can name it.
 WORK_PRIORITY = ("device", "transfer", "decode", "encode", "deflate",
-                 "stage", "fetch", "hedge", "hedge_wasted")
+                 "stage", "fetch", "hedge", "hedge_wasted",
+                 # service queue wait ranks last: it only wins instants
+                 # where nothing is making progress — lanes parked in
+                 # the batcher while the device sits idle
+                 "service_wait")
 
 ADVICE = {
     "fetch": "I/O-bound range reads: raise executor_workers / "
@@ -348,6 +356,10 @@ ADVICE = {
     "hedge_wasted": "hedge losses dominate: duplicates launch but "
                     "rarely win; raise hedge_quantile/hedge_min_s so "
                     "only real stragglers hedge",
+    "service_wait": "decode-service queue wait dominates: lanes sit "
+                    "batched while the device idles — lower "
+                    "DISQ_TPU_SERVICE_FLUSH_MS, or raise "
+                    "executor_workers so more shards feed the batcher",
 }
 
 

@@ -292,11 +292,11 @@ class TestOpsTelemetry:
 
         raw = b"fallback lane payload " * 4
         comp = zlib.compress(raw, 6)[2:-4]
-        words = np.zeros((64, inflate_simd.LANES), dtype=np.uint32)
-        meta = np.zeros((8, inflate_simd.LANES), dtype=np.int32)
+        lanes_u8 = np.zeros((inflate_simd.LANES, 64 * 4), dtype=np.uint8)
+        meta = np.zeros((4, inflate_simd.LANES), dtype=np.int32)
         meta[1, 0] = 3  # kernel flagged lane 0 -> host zlib re-inflates
-        out = inflate_simd._unpack_chunk(
-            [comp], 0, words, meta, [len(raw)])
-        assert out == [raw]
+        out = inflate_simd._finalize_lane(
+            comp, lanes_u8, meta, 0, len(raw))
+        assert out == raw
         assert REGISTRY.counter("device.host_fallback_blocks").value(
             reason="flagged") == 1
