@@ -366,7 +366,8 @@ def http_read_config(path: str, reps: int) -> dict:
             self.wfile.write(body)
 
     srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    threading.Thread(target=srv.serve_forever, name="disq-bench-http",
+                     daemon=True).start()
     url = f"http://127.0.0.1:{srv.server_address[1]}/bench.bam"
     rows = {}
     try:
@@ -619,6 +620,15 @@ def device_service_config(path: str) -> dict:
 
 
 def main() -> None:
+    # DISQ_TPU_POSTMORTEM_DIR arms the flight recorder for the whole
+    # bench: any abort writes a postmortem bundle there, and
+    # faulthandler is wired into the dir so a native-extension crash
+    # (disq_tpu/native) dumps tracebacks instead of dying silently.
+    if os.environ.get("DISQ_TPU_POSTMORTEM_DIR"):
+        from disq_tpu.runtime import flightrec
+
+        flightrec.enable(os.environ["DISQ_TPU_POSTMORTEM_DIR"])
+
     tmp = tempfile.mkdtemp(prefix="disq_bench_")
     path = os.path.join(tmp, "bench.bam")
     synth_bam(path, N_RECORDS)

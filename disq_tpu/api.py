@@ -58,12 +58,23 @@ def _telemetry_report(counters) -> dict:
         for name, series in kind.items()
         if name.startswith("device.")
     }
+    # Resilience rollup, mirroring the device key: the closed-loop
+    # fault-handling story (hedge races, breaker state machine, retry
+    # budget, deadline escalations) at a glance.
+    resilience = {
+        name: series
+        for kind in snapshot.values()
+        for name, series in kind.items()
+        if name.split(".", 1)[0] in ("hedge", "breaker", "budget",
+                                     "deadline")
+    }
     return {
         "run_id": tracing.RUN_ID,
         "process_id": process_id(),
         "counters": counters.as_dict() if counters is not None else {},
         "metrics": snapshot,
         "device": device,
+        "resilience": resilience,
         "phases": tracing.phase_report(),
         "gauges": tracing.gauge_report(),
         "span_log": tracing.span_log_path(),
@@ -433,6 +444,30 @@ class ReadsStorage:
         self._options = self._options.with_read_ledger(path)
         return self
 
+    def postmortem_dir(self, path: str) -> "ReadsStorage":
+        """Arm the flight recorder (``runtime/flightrec.py``): recent
+        pipeline events (retries, hedges, breaker transitions,
+        watchdog stalls, quarantines) are kept in a bounded ring, and
+        any abort — first-error-abort, watchdog abort, breaker storm,
+        or an explicit ``flightrec.dump()`` — writes a postmortem
+        bundle under ``path`` (thread stacks, metrics snapshot, span
+        tail, event ring, ledger tails, resolved options) for
+        ``scripts/trace_report.py --postmortem``.  Also wires
+        ``faulthandler`` into the dir so native crashes leave
+        tracebacks.  Env equivalent: ``DISQ_TPU_POSTMORTEM_DIR``."""
+        self._options = self._options.with_postmortem(path)
+        return self
+
+    def profile_hz(self, hz: float) -> "ReadsStorage":
+        """Start the in-process sampling profiler
+        (``runtime/profiler.py``) at ``hz``: folded stacks keyed by
+        the canonical ``disq-*`` thread names attribute CPU per
+        pipeline stage; export via ``/debug/profile``,
+        ``profiler.stop_profiler().collapsed()`` or a postmortem
+        bundle.  Env equivalent: ``DISQ_TPU_PROFILE_HZ``."""
+        self._options = self._options.with_profile(hz)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -452,10 +487,19 @@ class ReadsStorage:
         self, path: str, traversal: Optional[TraversalParameters] = None
     ) -> ReadsDataset:
         from disq_tpu.formats import sam_format_from_path
+        from disq_tpu.runtime import flightrec
 
         fmt = sam_format_from_path(path)
         source = fmt.make_source(self)
-        return source.get_reads(path, traversal)
+        try:
+            return source.get_reads(path, traversal)
+        except Exception as e:
+            # Postmortem backstop for aborts that never reach the
+            # executor (driver-side split planning, header decode) —
+            # the flight recorder dedupes errors the pipeline's own
+            # abort path already bundled.
+            flightrec.note_abort(e, where="read")
+            raise
 
     # -- write --------------------------------------------------------------
 
@@ -468,13 +512,19 @@ class ReadsStorage:
     ) -> None:
         from disq_tpu.formats import sam_format_from_write_options
 
+        from disq_tpu.runtime import flightrec
+
         if sort:
             dataset = dataset.coordinate_sorted()
         fmt_opt = _opt(options, ReadsFormatWriteOption, None)
         fmt = sam_format_from_write_options(path, fmt_opt)
         cardinality = _opt(options, FileCardinalityWriteOption, _infer_cardinality(path))
         sink = fmt.make_sink(self, cardinality)
-        sink.save(dataset, path, options)
+        try:
+            sink.save(dataset, path, options)
+        except Exception as e:
+            flightrec.note_abort(e, where="write")
+            raise
 
 
 class VariantsStorage:
@@ -575,6 +625,16 @@ class VariantsStorage:
         self._options = self._options.with_read_ledger(path)
         return self
 
+    def postmortem_dir(self, path: str) -> "VariantsStorage":
+        """See ``ReadsStorage.postmortem_dir``."""
+        self._options = self._options.with_postmortem(path)
+        return self
+
+    def profile_hz(self, hz: float) -> "VariantsStorage":
+        """See ``ReadsStorage.profile_hz``."""
+        self._options = self._options.with_profile(hz)
+        return self
+
     def num_shards(self, n: int) -> "VariantsStorage":
         self._num_shards = n
         return self
@@ -582,32 +642,43 @@ class VariantsStorage:
     def read(
         self, path: str, intervals: Optional[Sequence[Interval]] = None
     ) -> VariantsDataset:
-        if path.lower().endswith(".bcf"):
-            from disq_tpu.vcf.bcf import BcfSource
+        from disq_tpu.runtime import flightrec
 
-            return BcfSource(self).get_variants(path, intervals)
-        from disq_tpu.vcf.source import VcfSource
+        try:
+            if path.lower().endswith(".bcf"):
+                from disq_tpu.vcf.bcf import BcfSource
 
-        return VcfSource(self).get_variants(path, intervals)
+                return BcfSource(self).get_variants(path, intervals)
+            from disq_tpu.vcf.source import VcfSource
+
+            return VcfSource(self).get_variants(path, intervals)
+        except Exception as e:
+            flightrec.note_abort(e, where="read")
+            raise
 
     def write(
         self, dataset: VariantsDataset, path: str, *options: WriteOption
     ) -> None:
+        from disq_tpu.runtime import flightrec
         from disq_tpu.vcf.sink import VcfSink, VcfSinkMultiple
 
         fmt_opt = _opt(options, VariantsFormatWriteOption, None)
         cardinality = _opt(options, FileCardinalityWriteOption, _infer_cardinality(path))
-        if fmt_opt is VariantsFormatWriteOption.BCF or (
-            fmt_opt is None and path.lower().endswith(".bcf")
-        ):
-            from disq_tpu.vcf.bcf import BcfSink, BcfSinkMultiple
+        try:
+            if fmt_opt is VariantsFormatWriteOption.BCF or (
+                fmt_opt is None and path.lower().endswith(".bcf")
+            ):
+                from disq_tpu.vcf.bcf import BcfSink, BcfSinkMultiple
 
+                if cardinality is FileCardinalityWriteOption.SINGLE:
+                    BcfSink(self).save(dataset, path, options)
+                else:
+                    BcfSinkMultiple(self).save(dataset, path, options)
+                return
             if cardinality is FileCardinalityWriteOption.SINGLE:
-                BcfSink(self).save(dataset, path, options)
+                VcfSink(self).save(dataset, path, options)
             else:
-                BcfSinkMultiple(self).save(dataset, path, options)
-            return
-        if cardinality is FileCardinalityWriteOption.SINGLE:
-            VcfSink(self).save(dataset, path, options)
-        else:
-            VcfSinkMultiple(self).save(dataset, path, options)
+                VcfSinkMultiple(self).save(dataset, path, options)
+        except Exception as e:
+            flightrec.note_abort(e, where="write")
+            raise

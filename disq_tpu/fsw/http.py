@@ -82,7 +82,12 @@ class HttpFileSystemWrapper(FileSystemWrapper):
         self.prefetch = prefetch
         self.max_cached_blocks = max_cached_blocks
         self.stats = _Stats()
-        self._pool = ThreadPoolExecutor(max_workers=2)
+        # Canonical thread naming: the sampling profiler
+        # (runtime/profiler.py) and py-spy both attribute samples by
+        # disq-* thread names, so an anonymous pool would profile as
+        # "other".
+        self._pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="disq-http-prefetch")
         self._lock = threading.Lock()
         # (url, block_index) -> bytes or in-flight Future; LRU-bounded
         # (the wrapper is process-global via the scheme registry, so an

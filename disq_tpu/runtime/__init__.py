@@ -44,10 +44,24 @@ protocol. JAX has no task retry, so the equivalents here are:
   ``DisqOptions.watchdog_stall_s`` (policy ``warn`` | ``abort``), and
   a progress/ETA reporter with an optional periodic JSONL log
   (``DisqOptions.progress_log``).
+- ``flightrec`` — the postmortem half of observability: a bounded
+  event ring of recent decisions (retries, hedges, breaker
+  transitions, watchdog stalls, quarantines) and, on any abort path,
+  a postmortem bundle directory (thread stacks, metrics snapshot,
+  span tail, event ring, ledger tails, resolved options;
+  ``DisqOptions.postmortem_dir`` / ``DISQ_TPU_POSTMORTEM_DIR``) that
+  ``scripts/trace_report.py --postmortem`` renders; plus
+  ``faulthandler`` wiring for native crashes.
+- ``profiler`` — the in-process sampling profiler: folded stacks
+  keyed by the canonical ``disq-*`` thread names attribute CPU per
+  pipeline stage, exported as collapsed-stack / speedscope
+  (``DisqOptions.profile_hz`` / ``DISQ_TPU_PROFILE_HZ``, the
+  ``/debug/profile`` endpoint, ``trace_report.py --flame``).
 - ``cluster`` — the cross-host half of observability: a
   ``ClusterAggregator`` scraping N processes' introspection endpoints
   and serving a merged ``/metrics`` / ``/progress`` / ``/healthz``
-  rollup with per-process labels (CLI:
+  rollup with per-process labels, plus fleet-wide ``/debug/stacks`` /
+  ``/debug/profile`` collection (CLI:
   ``scripts/metrics_aggregate.py``).
 - ``multihost`` — multi-process jax scaffold: axis planning, the
   global (dcn, shards) mesh, and the ``process_id()`` identity every
@@ -123,6 +137,20 @@ from disq_tpu.runtime.introspect import (  # noqa: F401
     start_progress_log,
     stop_introspect_server,
     stop_progress_log,
+)
+from disq_tpu.runtime.flightrec import (  # noqa: F401
+    FlightRecorder,
+    record_event,
+    reset_flightrec,
+    thread_stacks_text,
+)
+from disq_tpu.runtime.profiler import (  # noqa: F401
+    SamplingProfiler,
+    active_profiler,
+    profile_for,
+    reset_profiler,
+    start_profiler,
+    stop_profiler,
 )
 from disq_tpu.runtime.manifest import (  # noqa: F401
     QuarantineManifest,

@@ -145,12 +145,15 @@ class ClusterAggregator:
 
     # -- scraping -----------------------------------------------------------
 
-    def _get(self, endpoint: str, path: str) -> bytes:
+    def _get(self, endpoint: str, path: str,
+             timeout_s: Optional[float] = None) -> bytes:
         base = endpoint
         if "://" not in base:
             base = "http://" + base
-        with urllib.request.urlopen(base + path,
-                                    timeout=self.timeout_s) as resp:
+        with urllib.request.urlopen(
+                base + path,
+                timeout=self.timeout_s if timeout_s is None
+                else timeout_s) as resp:
             return resp.read()
 
     def _scrape_one(self, worker: WorkerState) -> None:
@@ -211,8 +214,9 @@ class ClusterAggregator:
             workers = [WorkerState(e) for e in self.endpoints]
             threads = [
                 threading.Thread(target=self._scrape_one, args=(w,),
+                                 name=f"disq-cluster-scrape-{i}",
                                  daemon=True)
-                for w in workers
+                for i, w in enumerate(workers)
             ]
             for t in threads:
                 t.start()
@@ -399,6 +403,86 @@ class ClusterAggregator:
             "problems": problems,
         }
 
+    # -- fleet debug collection ---------------------------------------------
+
+    def _collect_debug(self, path: str,
+                       workers: Optional[List[WorkerState]] = None,
+                       extra_timeout_s: float = 0.0
+                       ) -> Dict[int, Dict[str, Any]]:
+        """Fetch one ``/debug/*`` path from every reachable worker
+        concurrently; ``{process_id: {"endpoint", "ok", "body"|"error"}}``.
+        Debug fetches are deliberately scrape-independent: a wedged
+        worker that no longer answers ``/metrics`` may still answer
+        ``/debug/stacks`` (the whole point of collecting stacks).
+        ``extra_timeout_s`` stretches the per-fetch timeout for paths
+        that legitimately block (a ``/debug/profile`` holds its
+        response for the whole sampling window)."""
+        if workers is None:
+            workers = self._fresh()
+        out: Dict[int, Dict[str, Any]] = {}
+        lock = threading.Lock()
+        timeout_s = self.timeout_s + extra_timeout_s
+
+        def fetch(worker: WorkerState, idx: int) -> None:
+            # scrape() guarantees unique ids, but externally-built
+            # WorkerStates may carry None — fall back to a unique
+            # negative slot so two unidentified workers never clobber
+            # each other's debug output.
+            pid = (worker.process_id if worker.process_id is not None
+                   else -(idx + 1))
+            try:
+                body = self._get(worker.endpoint, path,
+                                 timeout_s=timeout_s).decode()
+                doc = {"endpoint": worker.endpoint, "ok": True,
+                       "body": body}
+            except Exception as e:  # noqa: BLE001 — reachability verdict
+                doc = {"endpoint": worker.endpoint, "ok": False,
+                       "error": f"{type(e).__name__}: {e}"}
+            with lock:
+                out[pid] = doc
+
+        threads = [
+            threading.Thread(target=fetch, args=(w, i),
+                             name=f"disq-cluster-debug-{i}", daemon=True)
+            for i, w in enumerate(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    def debug_stacks(self, workers: Optional[List[WorkerState]] = None
+                     ) -> Dict[str, Any]:
+        """Every worker's all-thread stack dump, keyed by process id —
+        the cluster answer to "what is each process doing right now"."""
+        collected = self._collect_debug("/debug/stacks", workers)
+        return {
+            "cluster": True,
+            "processes": {
+                str(pid): doc for pid, doc in sorted(collected.items())
+            },
+        }
+
+    def debug_profile(self, seconds: float = 2.0,
+                      workers: Optional[List[WorkerState]] = None) -> str:
+        """Sample every worker for ``seconds`` concurrently and merge
+        the collapsed stacks into one document, each stack rooted at a
+        ``process=<id>`` frame — one flamegraph for the whole fleet,
+        split by process then thread role."""
+        seconds = max(0.05, min(60.0, float(seconds)))
+        collected = self._collect_debug(
+            "/debug/profile?seconds=%g" % seconds, workers,
+            extra_timeout_s=seconds)
+        lines: List[str] = []
+        for pid, doc in sorted(collected.items()):
+            if not doc.get("ok"):
+                continue
+            for line in doc["body"].splitlines():
+                if line.strip():
+                    lines.append(f"process={pid};{line}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
     # -- serving ------------------------------------------------------------
 
     def serve(self, port: int = 0) -> str:
@@ -423,7 +507,7 @@ class ClusterAggregator:
                 self.wfile.write(body)
 
             def do_GET(self) -> None:  # noqa: N802
-                path = self.path.partition("?")[0]
+                path, _, query = self.path.partition("?")
                 workers = aggregator._fresh()
                 if path == "/metrics":
                     self._send(
@@ -441,14 +525,40 @@ class ClusterAggregator:
                         200 if doc["status"] == "ok" else 503,
                         json.dumps(doc, default=str).encode(),
                         "application/json")
+                elif path == "/debug/stacks":
+                    self._send(
+                        200,
+                        json.dumps(aggregator.debug_stacks(workers),
+                                   default=str).encode(),
+                        "application/json")
+                elif path == "/debug/profile":
+                    seconds = 2.0
+                    for part in query.split("&"):
+                        if part.startswith("seconds="):
+                            try:
+                                seconds = float(part[len("seconds="):])
+                            except ValueError:
+                                pass
+                    self._send(
+                        200,
+                        aggregator.debug_profile(seconds,
+                                                 workers).encode(),
+                        "text/plain; charset=utf-8")
                 else:
                     self._send(404, json.dumps({
                         "error": "unknown path",
                         "endpoints": ["/metrics", "/progress",
-                                      "/healthz"]}).encode(),
+                                      "/healthz", "/debug/stacks",
+                                      "/debug/profile"]}).encode(),
                         "application/json")
 
-        srv = ThreadingHTTPServer(("127.0.0.1", int(port)), _Handler)
+        class _NamedServer(ThreadingHTTPServer):
+            # named request threads: profiler/py-spy attribution
+            def process_request_thread(self, request, client_address):
+                threading.current_thread().name = "disq-cluster-req"
+                super().process_request_thread(request, client_address)
+
+        srv = _NamedServer(("127.0.0.1", int(port)), _Handler)
         srv.daemon_threads = True
         self._server = srv
         self._address = "127.0.0.1:%d" % srv.server_address[1]

@@ -50,6 +50,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from disq_tpu.runtime import flightrec as _flightrec
 from disq_tpu.runtime.tracing import (
     counter as _counter,
     observe_gauge as _observe_gauge,
@@ -492,6 +493,8 @@ class DeviceDecodeService:
 
     def _launch(self, kind: str, lanes: List[_Lane], reason: str):
         _counter("device.batch.flush").inc(reason=reason)
+        _flightrec.record_event("device_flush", codec=kind,
+                                reason=reason, lanes=len(lanes))
         _observe_gauge("device.lane_fill", len(lanes) / LANES)
         _observe_gauge(
             "device.queue_depth",

@@ -38,6 +38,8 @@ import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Optional, TypeVar
 
+from disq_tpu.runtime import flightrec
+
 T = TypeVar("T")
 
 
@@ -133,6 +135,27 @@ class DisqOptions:
     - ``read_ledger`` points the crash-resumable *read* ledger at a
       directory: each decoded shard is spilled there as it emits, and
       a killed process re-runs only unfinished shards on restart.
+
+    Postmortem & profiling (``runtime/flightrec.py`` /
+    ``runtime/profiler.py`` — both off by default, zero threads and
+    zero per-shard work until armed):
+
+    - ``postmortem_dir`` turns the flight recorder on: recent events
+      (retries, hedges, breaker transitions, watchdog stalls,
+      quarantines) are kept in a bounded ring, and any abort path —
+      pipeline first-error-abort, watchdog abort, breaker storm, or an
+      explicit ``flightrec.dump()`` — writes a postmortem bundle
+      directory (thread stacks, metrics snapshot, span tail, event
+      ring, healthz/progress, ledger tails, resolved options) that
+      ``scripts/trace_report.py --postmortem`` renders.  Also wires
+      ``faulthandler`` into the dir for native crashes.  Env
+      equivalent: ``DISQ_TPU_POSTMORTEM_DIR``.
+    - ``profile_hz`` starts the in-process sampling profiler at that
+      rate: folded stacks keyed by the canonical ``disq-*`` thread
+      names attribute CPU per pipeline stage, exported as
+      collapsed-stack / speedscope (``profile.samples{thread_role=}``
+      / ``profile.dropped``).  Env equivalent:
+      ``DISQ_TPU_PROFILE_HZ``.
     """
 
     error_policy: ErrorPolicy = ErrorPolicy.STRICT
@@ -156,6 +179,8 @@ class DisqOptions:
     breaker_window: Optional[int] = None
     breaker_cooldown_s: float = 1.0
     read_ledger: Optional[str] = None
+    postmortem_dir: Optional[str] = None
+    profile_hz: Optional[float] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -221,6 +246,16 @@ class DisqOptions:
 
     def with_read_ledger(self, path: str) -> "DisqOptions":
         return replace(self, read_ledger=path)
+
+    def with_postmortem(self, path: str) -> "DisqOptions":
+        if not path:
+            raise ValueError("postmortem_dir must be a non-empty path")
+        return replace(self, postmortem_dir=path)
+
+    def with_profile(self, hz: float) -> "DisqOptions":
+        if hz <= 0:
+            raise ValueError(f"profile_hz must be > 0, got {hz}")
+        return replace(self, profile_hz=float(hz))
 
 
 class CorruptBlockError(ValueError):
@@ -482,6 +517,9 @@ class ShardRetrier:
                 attempt += 1
                 self.retried += 1
                 counter("retry.attempts").inc(what=what)
+                flightrec.record_event(
+                    "retry", what=what, attempt=attempt,
+                    error=f"{type(e).__name__}: {e}")
                 prev_sleep = self._next_backoff(prev_sleep)
                 with span("retry.backoff", what=what, attempt=attempt):
                     self._sleep(prev_sleep)
@@ -567,10 +605,18 @@ class ShardErrorContext:
             self.quarantined_blocks += 1
             if not silent:
                 counter("quarantine.blocks").inc(kind=kind)
+                flightrec.record_event(
+                    "quarantine", block_kind=kind, path=self.path,
+                    shard=self.shard_id, block_offset=block_offset,
+                    error=str(error))
         else:
             self.skipped_blocks += 1
             if not silent:
                 counter("errors.skipped_blocks").inc(kind=kind)
+                flightrec.record_event(
+                    "skipped_block", block_kind=kind, path=self.path,
+                    shard=self.shard_id, block_offset=block_offset,
+                    error=str(error))
 
     def silent(self) -> "ShardErrorContext":
         """A non-counting view for blocks this shard reads but does NOT
@@ -631,6 +677,9 @@ def context_for_storage(storage, path: str) -> ShardErrorContext:
         from disq_tpu.runtime.tracing import start_span_log
 
         start_span_log(opts.span_log)
+    # Arm the flight recorder before any shard work starts, so even a
+    # fault in split planning happens with the event ring live.
+    flightrec.configure_from_options(opts)
     breaker = None
     if (getattr(opts, "retry_budget_tokens", None) is not None
             or getattr(opts, "breaker_window", None) is not None):

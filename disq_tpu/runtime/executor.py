@@ -67,6 +67,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
+from disq_tpu.runtime import flightrec
 from disq_tpu.runtime.errors import (
     DeadlineExceededError,
     DisqOptions,
@@ -296,6 +297,11 @@ class _BoundedStagePipeline:
                         self.on_stall(time.perf_counter() - t0, tasks[i])
                         if i in errors:
                             state["aborted"] = True
+                            # The pipeline's first-error-abort IS the
+                            # postmortem moment: every stage worker is
+                            # still live, so the bundle's thread stacks
+                            # show what each one was doing.
+                            flightrec.note_abort(errors[i], where="emit")
                             raise errors[i]
                         value, seconds = results.pop(i)
                         state["next_emit"] = i + 1
@@ -404,6 +410,15 @@ class ShardPipelineExecutor:
         try:
             for task in tasks:
                 yield self._run_one_inline(task, token)
+        except GeneratorExit:
+            # Consumer stopped iterating early — a normal close, not
+            # an abort; no postmortem.
+            raise
+        except BaseException as e:
+            # Inline first-error-abort: same postmortem moment as the
+            # pipelined emit raise.
+            flightrec.note_abort(e, where="inline")
+            raise
         finally:
             if self._resilience is not None:
                 self._resilience.close()
@@ -587,10 +602,13 @@ def executor_for_storage(storage) -> ShardPipelineExecutor:
     hedging / deadline knobs are resolved once per run, and the
     default (nothing configured) hands the executor ``health=None`` /
     ``resilience=None`` — the no-op path."""
+    from disq_tpu.runtime import profiler
     from disq_tpu.runtime.introspect import configure_from_options
     from disq_tpu.runtime.resilience import resilience_for_options
 
     opts = getattr(storage, "_options", None) or DisqOptions()
+    flightrec.configure_from_options(opts)
+    profiler.configure_from_options(opts)
     return ShardPipelineExecutor(
         workers=getattr(opts, "executor_workers", 1),
         prefetch_shards=getattr(opts, "prefetch_shards", None),
@@ -805,24 +823,30 @@ class ShardWritePipeline:
         token: Optional[int] = None,
     ) -> Iterator[WriteShardResult]:
         health = self._health if token is not None else None
-        for task in tasks:
-            secs = []
-            payload = None
-            for name, fn in (("encode", self._encode),
-                             ("deflate", self._deflate),
-                             ("stage", self._stage)):
-                _check_abort(health, token)
-                if health is not None:
-                    health.beat(token, name, task.shard_id)
-                t0 = time.perf_counter()
-                payload = fn(task, payload)
-                secs.append(time.perf_counter() - t0)
-                if health is not None:
-                    health.clear(token, name, task.shard_id)
-            self.stats.encode_seconds += secs[0]
-            self.stats.deflate_seconds += secs[1]
-            self.stats.stage_seconds += secs[2]
-            yield WriteShardResult(task.shard_id, payload, *secs)
+        try:
+            for task in tasks:
+                secs = []
+                payload = None
+                for name, fn in (("encode", self._encode),
+                                 ("deflate", self._deflate),
+                                 ("stage", self._stage)):
+                    _check_abort(health, token)
+                    if health is not None:
+                        health.beat(token, name, task.shard_id)
+                    t0 = time.perf_counter()
+                    payload = fn(task, payload)
+                    secs.append(time.perf_counter() - t0)
+                    if health is not None:
+                        health.clear(token, name, task.shard_id)
+                self.stats.encode_seconds += secs[0]
+                self.stats.deflate_seconds += secs[1]
+                self.stats.stage_seconds += secs[2]
+                yield WriteShardResult(task.shard_id, payload, *secs)
+        except GeneratorExit:
+            raise  # early close of the iterator, not an abort
+        except BaseException as e:
+            flightrec.note_abort(e, where="inline")
+            raise
 
     # -- pipelined (workers>1) ----------------------------------------------
 
@@ -891,9 +915,12 @@ def writer_for_storage(storage) -> ShardWritePipeline:
     ``DisqOptions`` (absent/None ⇒ sequential-compatible defaults).
     Live-introspection knobs resolve here for writes, mirroring
     ``executor_for_storage`` for reads."""
+    from disq_tpu.runtime import profiler
     from disq_tpu.runtime.introspect import configure_from_options
 
     opts = getattr(storage, "_options", None) or DisqOptions()
+    flightrec.configure_from_options(opts)
+    profiler.configure_from_options(opts)
     return ShardWritePipeline(
         workers=getattr(opts, "writer_workers", 1),
         prefetch_shards=getattr(opts, "writer_prefetch_shards", None),

@@ -55,7 +55,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from disq_tpu.runtime import tracing
+from disq_tpu.runtime import flightrec, tracing
 from disq_tpu.runtime.errors import WatchdogStallError
 from disq_tpu.runtime.multihost import process_id as _process_id
 from disq_tpu.runtime.tracing import RUN_ID, counter, record_span
@@ -256,6 +256,10 @@ class PipelineHealth:
             counter("watchdog.stalled_shards").inc(stage=stage)
             record_span("watchdog.stall", age, shard=shard, stage=stage,
                         direction=run.direction)
+            flightrec.record_event(
+                "watchdog_stall", shard=shard, stage=stage,
+                age_s=round(age, 3), direction=run.direction,
+                policy=run.policy)
             self._warn(run, shard, stage, age, now)
             if run.policy == "abort" and not run.abort_sent:
                 run.abort_sent = True
@@ -541,6 +545,18 @@ _server_thread: Optional[threading.Thread] = None
 _address: Optional[str] = None
 
 
+class _NamedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-request threads carry a
+    canonical ``disq-*`` name — the sampling profiler and py-spy both
+    attribute by thread name, and an anonymous handler thread (e.g.
+    one blocking inside ``/debug/profile``) would profile as
+    ``other``."""
+
+    def process_request_thread(self, request, client_address):
+        threading.current_thread().name = "disq-introspect-req"
+        super().process_request_thread(request, client_address)
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "disq-tpu-introspect/1"
 
@@ -594,9 +610,53 @@ class _Handler(BaseHTTPRequestHandler):
                 "total_in_ring": len(ring),
                 "spans": ring[-n:],
             })
+        elif path == "/debug/stacks":
+            self._send(200, flightrec.thread_stacks_text().encode(),
+                       "text/plain; charset=utf-8")
+        elif path == "/debug/profile":
+            self._serve_profile(query)
+        elif path == "/debug/bundle":
+            bundle = flightrec.dump(reason="endpoint")
+            if bundle is None:
+                self._send_json({
+                    "error": "flight recorder disabled — set "
+                             "DisqOptions.postmortem_dir or "
+                             "DISQ_TPU_POSTMORTEM_DIR (or the "
+                             "per-process bundle cap was reached)",
+                }, 409)
+            else:
+                self._send_json({"bundle": bundle, "run_id": RUN_ID})
         else:
             self._send_json({"error": "unknown path", "endpoints": [
-                "/metrics", "/healthz", "/progress", "/spans"]}, 404)
+                "/metrics", "/healthz", "/progress", "/spans",
+                "/debug/stacks", "/debug/profile", "/debug/bundle"]},
+                404)
+
+    def _serve_profile(self, query: str) -> None:
+        """``/debug/profile?seconds=N&hz=M[&format=speedscope]``:
+        sample this process for N seconds (blocking this request
+        only — the server is threading) and return collapsed stacks
+        (default) or a speedscope JSON document."""
+        from disq_tpu.runtime import profiler
+
+        seconds, hz, fmt = 2.0, profiler.DEFAULT_HZ, "collapsed"
+        for part in query.split("&"):
+            key, _, value = part.partition("=")
+            try:
+                if key == "seconds":
+                    seconds = max(0.05, min(60.0, float(value)))
+                elif key == "hz":
+                    hz = max(1.0, min(1000.0, float(value)))
+            except ValueError:
+                pass
+            if key == "format":
+                fmt = value
+        prof = profiler.profile_for(seconds, hz)
+        if fmt == "speedscope":
+            self._send_json(prof.speedscope())
+        else:
+            self._send(200, prof.collapsed().encode(),
+                       "text/plain; charset=utf-8")
 
 
 def start_introspect_server(port: int = 0) -> str:
@@ -606,7 +666,8 @@ def start_introspect_server(port: int = 0) -> str:
     with _STATE_LOCK:
         if _server is not None:
             return _address  # type: ignore[return-value]
-        srv = ThreadingHTTPServer(("127.0.0.1", int(port)), _Handler)
+        srv = _NamedThreadingHTTPServer(("127.0.0.1", int(port)),
+                                        _Handler)
         srv.daemon_threads = True
         _server = srv
         _address = "127.0.0.1:%d" % srv.server_address[1]

@@ -34,9 +34,13 @@ class StageManifest:
     """
 
     def __init__(self, path: str, params: Optional[Dict[str, Any]] = None):
+        from disq_tpu.runtime import flightrec
         from disq_tpu.runtime.tracing import RUN_ID
 
         self.path = path
+        # Postmortem join: a bundle embeds this ledger's tail, so an
+        # aborted run's "which shards were done" survives the process.
+        flightrec.note_artifact("stage_manifest", path)
         # The parallel write pipeline records shard completion from its
         # stage workers as each shard's part lands — mark_done (ledger
         # mutation + atomic flush) must not interleave across threads.
@@ -186,10 +190,14 @@ class ReadLedger:
 
     def __init__(self, base_dir: str,
                  params: Optional[Dict[str, Any]] = None) -> None:
+        from disq_tpu.runtime import flightrec
+
         self.base_dir = base_dir
         os.makedirs(base_dir, exist_ok=True)
         self.manifest = StageManifest(
             os.path.join(base_dir, "MANIFEST.json"), params)
+        flightrec.note_artifact(
+            "read_ledger", os.path.join(base_dir, "MANIFEST.json"))
 
     def _spill_path(self, shard_id: int) -> str:
         return os.path.join(self.base_dir, f"shard-{shard_id}.pkl")
@@ -269,8 +277,11 @@ class QuarantineManifest:
     MANIFEST_NAME = "MANIFEST.jsonl"
 
     def __init__(self, base_dir: str):
+        from disq_tpu.runtime import flightrec
+
         self.base_dir = base_dir
         self.path = os.path.join(base_dir, self.MANIFEST_NAME)
+        flightrec.note_artifact("quarantine_manifest", self.path)
         self._entries: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._header_ok = False
         if os.path.exists(self.path):

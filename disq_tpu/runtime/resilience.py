@@ -54,6 +54,7 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional
 
+from disq_tpu.runtime import flightrec
 from disq_tpu.runtime.errors import (
     BreakerOpenError,
     DeadlineExceededError,
@@ -202,6 +203,8 @@ class CircuitBreaker:
         self._state = to
         self._state_since = now
         counter("breaker.transitions").inc(key=self.key, to=to)
+        flightrec.record_event("breaker_transition", key=self.key,
+                               to=to, window_s=round(now - since, 3))
         observe_gauge("breaker.state", _STATE_VALUE[to], key=self.key)
         # The window just left renders as a shaded band in trace_report:
         # open/half-open spans carry the window's real duration.
@@ -373,6 +376,10 @@ class ShardDeadline:
         """Raise (and book) once the budget is gone."""
         if self.exceeded():
             counter("deadline.exceeded").inc(what=what)
+            flightrec.record_event(
+                "deadline_exceeded", what=what, shard=self.shard_id,
+                elapsed_s=round(self.elapsed(), 3),
+                deadline_s=self.deadline_s)
             raise DeadlineExceededError(
                 "shard exceeded its deadline",
                 shard_id=self.shard_id,
@@ -481,6 +488,8 @@ class HedgeController:
         delay = self.threshold()
         if deadline is not None and deadline.should_force_hedge():
             counter("deadline.hedge_forced").inc()
+            flightrec.record_event("hedge_forced", shard=shard_id,
+                                   elapsed_s=round(deadline.elapsed(), 3))
             delay = 0.0
         pool = self._ensure_pool()
         t0 = time.perf_counter()
@@ -492,6 +501,8 @@ class HedgeController:
             return primary.result()
 
         counter("hedge.launched").inc()
+        flightrec.record_event("hedge_launched", shard=shard_id,
+                               delay_s=round(delay, 4))
         h0 = time.perf_counter()
 
         def duplicate() -> Any:

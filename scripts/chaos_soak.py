@@ -319,6 +319,98 @@ def breaker_leg(path, baseline) -> str:
         reset_resilience()
 
 
+_ABORT_CHILD = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from disq_tpu import ReadsStorage, WatchdogStallError
+from disq_tpu.fsw import (FaultInjectingFileSystemWrapper, FaultSpec,
+                          PosixFileSystemWrapper, register_filesystem)
+
+# Wedge one mid-file range fetch for 30s: the watchdog (abort policy)
+# must cancel the w=4 read, and the armed flight recorder must leave a
+# postmortem bundle behind before the process dies.
+register_filesystem("fault", FaultInjectingFileSystemWrapper(
+    PosixFileSystemWrapper(),
+    [FaultSpec(kind="stall", offset={target}, stall_s=30.0, times=1)]))
+st = (ReadsStorage.make_default().split_size(96 * 1024)
+      .executor_workers(4)
+      .watchdog(0.15, "abort")
+      .postmortem_dir({pmdir!r}))
+try:
+    st.read("fault://" + {path!r})
+except WatchdogStallError:
+    # The bundle is written synchronously before the abort surfaces;
+    # _exit skips the interpreter's pool join (a fetch worker is still
+    # inside the injected 30s stall).
+    os._exit(17)
+os._exit(3)
+"""
+
+
+def postmortem_check(tmp) -> str:
+    """A chaos-induced watchdog abort (w=4) must leave a complete
+    postmortem bundle that ``trace_report.py --postmortem`` renders
+    into a verdict naming the stalled shard."""
+    import subprocess
+    import sys as _sys
+
+    from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+    from disq_tpu import ReadsStorage
+    from disq_tpu.api import SbiWriteOption
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pm_dir = os.path.join(tmp, "postmortem")
+    raw = os.path.join(tmp, "postmortem-raw.bam")
+    big = os.path.join(tmp, "postmortem.bam")
+    # Big enough that a mid-file byte lies past the 256 KiB header
+    # readahead, and written WITH its .sbi so split boundaries come
+    # from the index: the stall then fires inside a heartbeated split
+    # fetch, not a driver-side guess read.
+    with open(raw, "wb") as f:
+        f.write(make_bam_bytes(DEFAULT_REFS, synth_records(5000, seed=5)))
+    ds = ReadsStorage.make_default().read(raw)
+    ReadsStorage.make_default().num_shards(6).write(
+        ds, big, SbiWriteOption.ENABLE)
+    size = os.path.getsize(big)
+    target = max(size * 3 // 5, 256 * 1024 + 32 * 1024)
+    if target >= size:
+        return ("postmortem: fixture too small for a mid-file stall "
+                f"({size} bytes)")
+    child = subprocess.run(
+        [_sys.executable, "-c", _ABORT_CHILD.format(
+            repo=repo, path=big, pmdir=pm_dir, target=target)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if child.returncode != 17:
+        return ("postmortem: abort child exited "
+                f"{child.returncode} (wanted 17 = WatchdogStallError): "
+                + child.stderr[-500:])
+    bundles = sorted(
+        d for d in (os.listdir(pm_dir) if os.path.isdir(pm_dir) else [])
+        if d.startswith("bundle-"))
+    if not bundles:
+        return "postmortem: watchdog abort left no bundle directory"
+    bundle = os.path.join(pm_dir, bundles[-1])
+    required = {"MANIFEST.json", "stacks.txt", "metrics.prom",
+                "spans.jsonl", "events.jsonl"}
+    missing = required - set(os.listdir(bundle))
+    if missing:
+        return f"postmortem: bundle missing artifacts {sorted(missing)}"
+    rep = subprocess.run(
+        [_sys.executable,
+         os.path.join(repo, "scripts", "trace_report.py"),
+         "--postmortem", bundle],
+        capture_output=True, text=True, timeout=60)
+    if rep.returncode != 0:
+        return f"postmortem: trace_report failed: {rep.stderr[-300:]}"
+    if "verdict: shard" not in rep.stdout:
+        return ("postmortem: report did not name the stalled shard:\n"
+                + rep.stdout[:500])
+    return ""
+
+
 _KILL_CHILD = r"""
 import os, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -435,7 +527,10 @@ def kill_leg(path, tmp) -> str:
     with open(out, "rb") as fa, open(clean, "rb") as fb:
         if fa.read() != fb.read():
             return "kill: resumed output differs from a fault-free run"
-    return ""
+
+    # Crash-leg postmortem contract: a chaos-induced abort must leave
+    # a renderable bundle (runtime/flightrec.py), not just a ledger.
+    return postmortem_check(tmp)
 
 
 def main(argv=None) -> int:
@@ -482,6 +577,14 @@ def main(argv=None) -> int:
                          "re-ran (via the ledger) and the final bytes "
                          "match a fault-free run")
     args = ap.parse_args(argv)
+
+    # DISQ_TPU_POSTMORTEM_DIR arms the flight recorder for the soak
+    # itself and wires faulthandler into the dir, so a native-extension
+    # crash under chaos dumps tracebacks instead of dying silently.
+    if os.environ.get("DISQ_TPU_POSTMORTEM_DIR"):
+        from disq_tpu.runtime import flightrec
+
+        flightrec.enable(os.environ["DISQ_TPU_POSTMORTEM_DIR"])
 
     from disq_tpu import ReadsStorage
 

@@ -51,6 +51,10 @@ ALLOWED_PREFIXES = {
     # bookkeeping, circuit-breaker state machine, per-shard deadline
     # escalation, and the shared retry token bucket.
     "hedge", "breaker", "deadline", "budget",
+    # Postmortem + profiling (runtime/flightrec.py /
+    # runtime/profiler.py): event-ring + bundle bookkeeping and the
+    # sampling profiler's per-role sample counters.
+    "flightrec", "profile",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

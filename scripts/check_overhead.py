@@ -84,10 +84,22 @@ def main() -> int:
         t.name for t in threading.enumerate()
         if t.name.startswith(
             ("disq-watchdog", "disq-introspect", "disq-device",
-             "disq-hostwork"))
+             "disq-hostwork", "disq-profiler"))
     ]
     if bad_threads:
         errors.append(f"stray observability threads: {bad_threads}")
+
+    # -- 1a. flight recorder + profiler: disabled ⇒ nothing exists -----------
+    from disq_tpu.runtime import flightrec, profiler
+
+    if flightrec.enabled() or flightrec.recorder() is not None:
+        errors.append(
+            "flight recorder instantiated with no postmortem knob — "
+            "the default path must allocate no event ring")
+    if profiler.active_profiler() is not None:
+        errors.append(
+            "sampling profiler running with no profile_hz knob — the "
+            "default path must spawn zero profiler threads")
 
     # -- 1b. device decode service: disabled ⇒ no thread, no queue -----------
     from disq_tpu.runtime import device_service
@@ -138,6 +150,23 @@ def main() -> int:
             f"disabled (budget {NOTE_BUDGET_US} us) — it must return "
             "after one boolean test")
 
+    # -- 4. timing: record_event with the recorder off -----------------------
+    def run_events():
+        for _ in range(NOTE_CALLS):
+            flightrec.record_event("retry", what="x")
+
+    run_events()  # warm-up
+    per_event_us = _median_per_unit_us(run_events, NOTE_CALLS)
+    if per_event_us > NOTE_BUDGET_US:
+        errors.append(
+            f"flightrec.record_event costs {per_event_us:.2f} us/call "
+            f"disabled (budget {NOTE_BUDGET_US} us) — it must return "
+            "after one global-is-None test")
+    if flightrec.recorder() is not None:
+        errors.append(
+            "record_event on the disabled path allocated a recorder — "
+            "the event ring must only exist once a knob configures it")
+
     if errors:
         print(f"check_overhead: {len(errors)} problem(s)")
         for e in errors:
@@ -147,6 +176,7 @@ def main() -> int:
         "check_overhead: OK "
         f"(executor {per_shard_us:.1f} us/shard, "
         f"note_shard_counters {per_note_us:.3f} us/call, "
+        f"record_event {per_event_us:.3f} us/call, "
         "no stray threads)")
     return 0
 

@@ -33,6 +33,8 @@ Usage::
         [--run RUN_ID] [--chrome out.json]
     python scripts/trace_report.py spans.jsonl --analyze
     python scripts/trace_report.py progress.jsonl --progress
+    python scripts/trace_report.py profile.collapsed --flame
+    python scripts/trace_report.py --postmortem <bundle-dir>
 
 ``--chrome`` additionally converts the spans to Chrome/Perfetto
 ``trace_event`` JSON (open in chrome://tracing or ui.perfetto.dev;
@@ -45,6 +47,16 @@ a one-line verdict names the bottleneck with the knob that moves it.
 ``--progress`` instead replays a progress JSONL
 (``DisqOptions.progress_log``) into a per-direction
 throughput-over-time ASCII sparkline.
+``--flame`` treats the input as *collapsed stacks* (the sampling
+profiler's export — ``/debug/profile``, ``profiler.collapsed()``, or
+a bundle's ``profile.collapsed``) and renders an ASCII flame plus the
+top-N functions by self/inclusive samples.
+``--postmortem <bundle>`` renders a flight-recorder bundle
+(``runtime/flightrec.py``, written on any abort when
+``DisqOptions.postmortem_dir`` is set) into a one-page verdict: the
+abort reason and error, the stalled/aborting shard named from the
+event ring, the event tail, and the span analyzer's wall-clock
+attribution merged in.
 """
 
 from __future__ import annotations
@@ -536,6 +548,201 @@ def analyze(spans, run, runs, dropped: int = 0) -> str:
 
 
 # ---------------------------------------------------------------------------
+# --flame: collapsed stacks -> ASCII flame + top-N function table
+# ---------------------------------------------------------------------------
+
+
+def load_collapsed(path: str) -> List:
+    """``(frames, count)`` pairs from a collapsed-stack file (one
+    ``frame;frame;frame count`` line per folded stack)."""
+    stacks = []
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            stack, _, count = line.rpartition(" ")
+            try:
+                n = int(count)
+            except ValueError:
+                continue
+            frames = [p for p in stack.split(";") if p]
+            if frames and n > 0:
+                stacks.append((frames, n))
+    return stacks
+
+
+def flame_report(stacks, top: int, width: int,
+                 min_fraction: float = 0.01) -> str:
+    """ASCII flame (inclusive samples down a prefix trie, pruned below
+    ``min_fraction`` of the total) + top-N functions by self and by
+    inclusive samples.  The profiler roots every stack at its thread
+    role, so the first tier of the flame is the per-stage CPU split."""
+    if not stacks:
+        return "no samples found (empty or non-collapsed input)\n"
+    total = sum(n for _f, n in stacks)
+    root: Dict[str, list] = {}
+    self_counts: Dict[str, int] = defaultdict(int)
+    incl_counts: Dict[str, int] = defaultdict(int)
+    for frames, n in stacks:
+        node = root
+        for f in frames:
+            entry = node.setdefault(f, [0, {}])
+            entry[0] += n
+            node = entry[1]
+        self_counts[frames[-1]] += n
+        for f in set(frames):
+            incl_counts[f] += n
+    out: List[str] = [
+        f"flame: {total} samples, {len(stacks)} folded stacks",
+        "",
+        f"ascii flame (inclusive; branches under "
+        f"{min_fraction * 100:.0f}% pruned)",
+    ]
+    bar_w = max(10, width - 46)
+    threshold = max(1.0, total * min_fraction)
+
+    def walk(node: Dict[str, list], depth: int) -> None:
+        for name, (count, children) in sorted(
+                node.items(), key=lambda kv: -kv[1][0]):
+            if count < threshold:
+                continue
+            bar = max(1, int(count / total * bar_w))
+            label = ("  " * depth + name)[:42]
+            out.append(f"  {label:<42} {'#' * bar:<{bar_w}} "
+                       f"{count / total * 100:5.1f}%")
+            walk(children, depth + 1)
+
+    walk(root, 0)
+    out.append("")
+    out.append(f"top-{top} functions by self samples")
+    for name, n in sorted(self_counts.items(),
+                          key=lambda kv: -kv[1])[:top]:
+        out.append(f"  {name:<46} {n:>8}  {n / total * 100:5.1f}%")
+    out.append("")
+    out.append(f"top-{top} functions by inclusive samples")
+    for name, n in sorted(incl_counts.items(),
+                          key=lambda kv: -kv[1])[:top]:
+        out.append(f"  {name:<46} {n:>8}  {n / total * 100:5.1f}%")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# --postmortem: render a flight-recorder bundle into a one-page verdict
+# ---------------------------------------------------------------------------
+
+
+def _load_bundle_json(bundle: str, name: str) -> Dict[str, Any]:
+    try:
+        with open(os.path.join(bundle, name)) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _load_bundle_jsonl(bundle: str, name: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(os.path.join(bundle, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _fmt_event(e: Dict[str, Any]) -> str:
+    extra = " ".join(
+        f"{k}={v}" for k, v in e.items()
+        if k not in ("ts", "mono", "kind") and v is not None)
+    return f"{e.get('kind', '?'):<18} {extra}"
+
+
+def postmortem_report(bundle: str, top: int, width: int) -> str:
+    """One-page bundle verdict: the abort, the shard it names, the
+    event-ring tail, and the span analyzer's attribution merged in."""
+    manifest = _load_bundle_json(bundle, "MANIFEST.json")
+    options = _load_bundle_json(bundle, "options.json")
+    healthz = _load_bundle_json(bundle, "healthz.json")
+    events = _load_bundle_jsonl(bundle, "events.jsonl")
+    if not (manifest or options or events):
+        return f"not a postmortem bundle (no MANIFEST.json / " \
+               f"events.jsonl under {bundle})\n"
+    out: List[str] = []
+    out.append(f"postmortem bundle {bundle}")
+    out.append(
+        f"  run {manifest.get('run_id', '?')}  "
+        f"pid {manifest.get('pid', '?')}  "
+        f"reason {manifest.get('reason', '?')}")
+    error = manifest.get("error") or options.get("error")
+    if error:
+        out.append(f"  error: {error}")
+    if healthz.get("status"):
+        out.append(f"  healthz at dump: {healthz['status']}"
+                   + (f" ({len(healthz.get('stalls') or [])} live "
+                      "stalls)" if healthz.get("stalls") else ""))
+    out.append("")
+
+    # -- verdict: name the shard the event ring blames -----------------------
+    stall = next((e for e in reversed(events)
+                  if e.get("kind") == "watchdog_stall"), None)
+    abort = next((e for e in reversed(events)
+                  if e.get("kind") == "abort"), None)
+    if stall is not None:
+        out.append(
+            f"verdict: shard {stall.get('shard', '?')} stalled in "
+            f"{stall.get('stage', '?')} "
+            f"({stall.get('age_s', '?')}s silent, "
+            f"direction {stall.get('direction', '?')}, "
+            f"policy {stall.get('policy', '?')})")
+    elif abort is not None and abort.get("shard") is not None:
+        out.append(
+            f"verdict: aborted on shard {abort['shard']} — "
+            f"{abort.get('error', '?')}")
+    elif abort is not None:
+        out.append(f"verdict: run aborted — {abort.get('error', '?')}")
+    else:
+        out.append(
+            f"verdict: {manifest.get('reason', 'explicit')} dump "
+            "(no abort recorded in the event ring)")
+    out.append("")
+
+    # -- event ring ----------------------------------------------------------
+    if events:
+        tally: Dict[str, int] = defaultdict(int)
+        for e in events:
+            tally[e.get("kind", "?")] += 1
+        out.append(
+            f"event ring ({len(events)} events): "
+            + ", ".join(f"{k}={n}" for k, n in sorted(
+                tally.items(), key=lambda kv: -kv[1])))
+        t0 = events[0].get("mono", 0.0)
+        out.append(f"  last {min(15, len(events))} events "
+                   "(t relative to the oldest kept)")
+        for e in events[-15:]:
+            rel = (e.get("mono", 0.0) or 0.0) - (t0 or 0.0)
+            out.append(f"    +{rel:9.3f}s  {_fmt_event(e)}")
+        out.append("")
+
+    # -- analyzer merge ------------------------------------------------------
+    spans_path = os.path.join(bundle, "spans.jsonl")
+    if os.path.exists(spans_path):
+        spans, run, runs, dropped = load_spans(spans_path)
+        if spans:
+            out.append("span analyzer over the bundle's span tail")
+            out.append("")
+            out.append(analyze(spans, run, runs, dropped).rstrip("\n"))
+            out.append("")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # --progress: replay a progress JSONL (DisqOptions.progress_log) into a
 # throughput-over-time sparkline
 # ---------------------------------------------------------------------------
@@ -643,10 +850,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-shard waterfall + latency report from a "
                     "disq_tpu span JSONL")
-    ap.add_argument("jsonl", help="span log written via "
+    ap.add_argument("jsonl", nargs="?", default=None,
+                    help="span log written via "
                     "DISQ_TPU_TRACE_JSONL / DisqOptions.span_log "
-                    "(or, with --progress, a DisqOptions.progress_log "
-                    "JSONL)")
+                    "(with --progress, a DisqOptions.progress_log "
+                    "JSONL; with --flame, a collapsed-stack profile; "
+                    "unused with --postmortem)")
     ap.add_argument("--top", type=int, default=5,
                     help="straggler shards to list (default 5)")
     ap.add_argument("--width", type=int, default=72,
@@ -664,7 +873,29 @@ def main(argv=None) -> int:
                     "waterfall: wall-clock attribution by "
                     "stage/stall/transfer bucket and a one-line "
                     "bottleneck verdict")
+    ap.add_argument("--flame", action="store_true",
+                    help="treat the input as collapsed stacks (the "
+                    "sampling profiler's export) and render an ASCII "
+                    "flame + top-N function tables")
+    ap.add_argument("--postmortem", default=None, metavar="BUNDLE",
+                    help="render a flight-recorder postmortem bundle "
+                    "directory (DisqOptions.postmortem_dir) into a "
+                    "one-page verdict")
     args = ap.parse_args(argv)
+
+    if args.postmortem:
+        sys.stdout.write(
+            postmortem_report(args.postmortem, args.top, args.width))
+        return 0
+
+    if args.jsonl is None:
+        ap.error("an input file is required (or use --postmortem "
+                 "<bundle-dir>)")
+
+    if args.flame:
+        sys.stdout.write(flame_report(
+            load_collapsed(args.jsonl), args.top, args.width))
+        return 0
 
     if args.progress:
         recs, run, runs = load_progress(args.jsonl, args.run)

@@ -1,0 +1,329 @@
+"""Flight recorder (``runtime/flightrec.py``): bounded event ring,
+postmortem bundles on every abort path, the zero-write disabled
+default, the ``/debug/bundle`` endpoint surface, and the acceptance
+scenario — a chaos-induced watchdog abort at ``executor_workers=4``
+leaves a bundle that ``trace_report.py --postmortem`` renders into a
+verdict naming the stalled shard."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+from disq_tpu import (
+    CorruptBlockError,
+    ReadsStorage,
+    WatchdogStallError,
+)
+from disq_tpu.fsw import (
+    FaultInjectingFileSystemWrapper,
+    FaultSpec,
+    PosixFileSystemWrapper,
+    register_filesystem,
+)
+from disq_tpu.runtime import flightrec
+from disq_tpu.runtime.introspect import reset_introspection
+from disq_tpu.runtime.tracing import RUN_ID, counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_REPORT = os.path.join(REPO, "scripts", "trace_report.py")
+
+# Mid-file stalls must land past the header readahead window so they
+# fire inside a heartbeated split fetch (same geometry as
+# tests/test_introspect.py).
+HEADER_READAHEAD = 256 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean_flightrec():
+    flightrec.reset_flightrec()
+    reset_introspection()
+    yield
+    flightrec.reset_flightrec()
+    reset_introspection()
+
+
+@pytest.fixture(scope="module")
+def big_bam(tmp_path_factory):
+    """Framework-written WITH its .sbi so split boundaries come from
+    the index — no driver-side guess read ever covers the stall
+    target, so the injected stall fires inside a heartbeated split
+    fetch (same geometry as tests/test_introspect.py)."""
+    from disq_tpu.api import SbiWriteOption
+
+    tmp = tmp_path_factory.mktemp("flightrec")
+    raw = tmp / "raw.bam"
+    raw.write_bytes(
+        make_bam_bytes(DEFAULT_REFS, synth_records(5000, seed=21)))
+    ds = ReadsStorage.make_default().read(str(raw))
+    path = tmp / "big.bam"
+    ReadsStorage.make_default().num_shards(6).write(
+        ds, str(path), SbiWriteOption.ENABLE)
+    assert os.path.exists(str(path) + ".sbi")
+    size = os.path.getsize(path)
+    assert size > HEADER_READAHEAD + 64 * 1024, size
+    return str(path), size
+
+
+@pytest.fixture(scope="module")
+def small_bam(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("flightrec-small")
+    path = tmp / "small.bam"
+    path.write_bytes(
+        make_bam_bytes(DEFAULT_REFS, synth_records(400, seed=9),
+                       blocksize=600))
+    return str(path)
+
+
+class TestRing:
+    def test_ring_bounded_and_counted(self, tmp_path):
+        rec = flightrec.enable(str(tmp_path / "pm"), capacity=32)
+        before = counter("flightrec.events").value(kind="retry")
+        for i in range(100):
+            flightrec.record_event("retry", what="t", attempt=i)
+        events = rec.events()
+        assert len(events) == 32, "ring must drop the oldest past cap"
+        # the survivors are the newest 32
+        assert [e["attempt"] for e in events] == list(range(68, 100))
+        assert (counter("flightrec.events").value(kind="retry")
+                - before) == 100
+
+    def test_disabled_path_records_and_writes_nothing(self, tmp_path):
+        target = tmp_path / "never"
+        assert flightrec.recorder() is None
+        flightrec.record_event("retry", what="x")
+        flightrec.note_artifact("ledger", str(target / "l.jsonl"))
+        flightrec.note_abort(ValueError("boom"))
+        assert flightrec.dump("explicit") is None
+        assert flightrec.recorder() is None, \
+            "disabled hooks must not allocate a recorder"
+        assert not target.exists()
+
+    def test_events_carry_clock_and_fields(self, tmp_path):
+        rec = flightrec.enable(str(tmp_path / "pm"))
+        flightrec.record_event("breaker_transition", key="http", to="open")
+        e = rec.events()[-1]
+        assert e["kind"] == "breaker_transition"
+        assert e["key"] == "http" and e["to"] == "open"
+        assert e["ts"] > 0 and e["mono"] > 0
+
+
+class TestDump:
+    def test_explicit_dump_contains_all_artifacts(self, tmp_path):
+        pm = str(tmp_path / "pm")
+        rec = flightrec.enable(pm)
+        ledger = tmp_path / "quarantine.jsonl"
+        ledger.write_text('{"version": 1}\n{"block_offset": 7}\n')
+        rec.note_artifact("quarantine_manifest", str(ledger))
+        flightrec.record_event("retry", what="t", attempt=1)
+        bundle = flightrec.dump("explicit")
+        assert bundle is not None and os.path.isdir(bundle)
+        names = set(os.listdir(bundle))
+        for required in ("MANIFEST.json", "stacks.txt", "metrics.prom",
+                         "spans.jsonl", "events.jsonl", "healthz.json",
+                         "progress.json", "options.json"):
+            assert required in names, (required, names)
+        manifest = json.loads(
+            (tmp_path / "pm" / os.path.basename(bundle)
+             / "MANIFEST.json").read_text())
+        assert manifest["run_id"] == RUN_ID
+        assert manifest["reason"] == "explicit"
+        # the noted ledger's tail rode along
+        tails = [n for n in names if n.startswith("ledger-")]
+        assert tails, names
+        tail_body = (tmp_path / "pm" / os.path.basename(bundle)
+                     / tails[0]).read_text()
+        assert '"block_offset": 7' in tail_body
+        # stacks name this thread; events round-trip as JSONL
+        stacks = open(os.path.join(bundle, "stacks.txt")).read()
+        assert "MainThread" in stacks
+        events = [json.loads(line) for line in
+                  open(os.path.join(bundle, "events.jsonl"))]
+        assert any(e["kind"] == "retry" for e in events)
+        assert counter("flightrec.dumps").value(reason="explicit") >= 1
+
+    def test_faulthandler_wired_into_dir(self, tmp_path):
+        import faulthandler
+
+        pm = str(tmp_path / "pm")
+        flightrec.enable(pm)
+        assert faulthandler.is_enabled()
+        assert os.path.exists(
+            os.path.join(pm, f"crash-{os.getpid()}.log"))
+
+    def test_abort_dedupes_one_exception(self, tmp_path):
+        pm = str(tmp_path / "pm")
+        flightrec.enable(pm)
+        exc = ValueError("same object")
+        flightrec.note_abort(exc)
+        flightrec.note_abort(exc)  # emit + generator-finally double-fire
+        bundles = [d for d in os.listdir(pm) if d.startswith("bundle-")]
+        assert len(bundles) == 1, bundles
+
+
+class TestAbortPaths:
+    def test_strict_corrupt_abort_writes_bundle(self, small_bam,
+                                                tmp_path):
+        """The pipelines' first-error-abort (here: strict policy on a
+        bit-flipped block) is a postmortem moment on the inline path."""
+        from disq_tpu.bgzf.block import parse_block_header
+
+        pm = str(tmp_path / "pm")
+        data = bytearray(open(small_bam, "rb").read())
+        # Damage a mid-file block's DEFLATE payload (chaos_soak's
+        # rel=+20 geometry) so the corruption surfaces in the decode
+        # stage, not in driver-side split planning.
+        layout, pos = [], 0
+        while pos < len(data):
+            total = parse_block_header(bytes(data), pos)
+            layout.append(pos)
+            pos += total
+        data[layout[len(layout) // 2] + 20] ^= 0x10
+        bad = tmp_path / "bad.bam"
+        bad.write_bytes(bytes(data))
+        st = (ReadsStorage.make_default().split_size(4096)
+              .postmortem_dir(pm))
+        with pytest.raises((CorruptBlockError, ValueError)):
+            st.read(str(bad))
+        bundles = [d for d in os.listdir(pm) if d.startswith("bundle-")]
+        assert bundles, "inline first-error-abort left no bundle"
+        manifest = json.loads(open(
+            os.path.join(pm, bundles[-1], "MANIFEST.json")).read())
+        assert manifest["reason"] == "pipeline_abort"
+        events = [json.loads(line) for line in open(
+            os.path.join(pm, bundles[-1], "events.jsonl"))]
+        assert events[-1]["kind"] == "abort"
+
+    def test_watchdog_abort_bundle_names_stalled_shard(self, big_bam,
+                                                       tmp_path):
+        """Acceptance: a chaos-induced watchdog abort at w=4 produces a
+        bundle with thread stacks, metrics, span tail and event ring
+        that ``trace_report.py --postmortem`` renders into a verdict
+        naming the stalled shard."""
+        path, size = big_bam
+        pm = str(tmp_path / "pm")
+        target = max(size * 3 // 5, HEADER_READAHEAD + 32 * 1024)
+        assert target < size
+        register_filesystem("pmfault", FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(),
+            [FaultSpec(kind="stall", offset=target, stall_s=8.0,
+                       times=1)],
+            scheme="pmfault"))
+        st = (ReadsStorage.make_default().split_size(96 * 1024)
+              .executor_workers(4)
+              .watchdog(0.15, "abort")
+              .postmortem_dir(pm))
+        with pytest.raises(WatchdogStallError) as ei:
+            st.read("pmfault://" + path)
+        stalled = ei.value.shard_id
+        assert stalled >= 0
+        bundles = sorted(
+            d for d in os.listdir(pm) if d.startswith("bundle-"))
+        assert bundles, "watchdog abort left no bundle"
+        bundle = os.path.join(pm, bundles[-1])
+        names = set(os.listdir(bundle))
+        assert {"stacks.txt", "metrics.prom", "spans.jsonl",
+                "events.jsonl", "MANIFEST.json"} <= names
+        # event ring holds the stall AND the abort, naming the shard
+        events = [json.loads(line) for line in
+                  open(os.path.join(bundle, "events.jsonl"))]
+        stalls = [e for e in events if e["kind"] == "watchdog_stall"]
+        assert stalls and stalls[-1]["shard"] == stalled
+        assert stalls[-1]["stage"] == "fetch"
+        aborts = [e for e in events if e["kind"] == "abort"]
+        assert aborts and aborts[-1]["reason"] == "watchdog_abort"
+        # metrics snapshot is a real Prometheus exposition
+        prom = open(os.path.join(bundle, "metrics.prom")).read()
+        assert "disq_tpu_watchdog_stalled_shards" in prom
+        # stacks show the named pipeline workers (the stalled fetch
+        # thread is still inside the injected sleep at dump time)
+        stacks = open(os.path.join(bundle, "stacks.txt")).read()
+        assert "disq-fetch" in stacks
+        # the CLI renders the verdict and names the shard
+        proc = subprocess.run(
+            [sys.executable, TRACE_REPORT, "--postmortem", bundle],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert f"verdict: shard {stalled} stalled in fetch" \
+            in proc.stdout, proc.stdout
+
+    def test_options_json_captures_resolved_options(self, big_bam,
+                                                    tmp_path):
+        path, _size = big_bam
+        pm = str(tmp_path / "pm")
+        st = (ReadsStorage.make_default().split_size(96 * 1024)
+              .executor_workers(2).postmortem_dir(pm))
+        st.read(path)  # clean run: configures the recorder, no bundle
+        assert not [d for d in os.listdir(pm)
+                    if d.startswith("bundle-")], \
+            "a clean run must not dump bundles"
+        bundle = flightrec.dump("explicit")
+        doc = json.loads(open(
+            os.path.join(bundle, "options.json")).read())
+        assert doc["options"]["executor_workers"] == 2
+        assert doc["options"]["postmortem_dir"] == pm
+        assert doc["run_id"] == RUN_ID
+        assert "JAX_PLATFORMS" in doc["env"]
+
+    def test_bundle_cap_bounds_abort_storms(self, tmp_path):
+        pm = str(tmp_path / "pm")
+        flightrec.enable(pm)
+        paths = [flightrec.dump("explicit")
+                 for _ in range(flightrec.MAX_BUNDLES + 5)]
+        written = [p for p in paths if p is not None]
+        assert len(written) == flightrec.MAX_BUNDLES
+        assert paths[-1] is None
+
+
+class TestEndpointAndBuilders:
+    def test_debug_bundle_endpoint(self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        from disq_tpu.runtime.introspect import start_introspect_server
+
+        addr = start_introspect_server(0)
+        # disabled: 409, no bundle
+        try:
+            urllib.request.urlopen(f"http://{addr}/debug/bundle",
+                                   timeout=5)
+            raise AssertionError("expected HTTP 409 while disabled")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        flightrec.enable(str(tmp_path / "pm"))
+        with urllib.request.urlopen(f"http://{addr}/debug/bundle",
+                                    timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert os.path.isdir(doc["bundle"])
+        assert counter("flightrec.dumps").value(reason="endpoint") >= 1
+
+    def test_debug_stacks_endpoint(self):
+        import urllib.request
+
+        from disq_tpu.runtime.introspect import start_introspect_server
+
+        addr = start_introspect_server(0)
+        with urllib.request.urlopen(f"http://{addr}/debug/stacks",
+                                    timeout=5) as resp:
+            body = resp.read().decode()
+        assert "MainThread" in body and "disq-introspect" in body
+
+    def test_option_validation_and_env_knob(self, tmp_path):
+        from disq_tpu import DisqOptions
+
+        with pytest.raises(ValueError):
+            DisqOptions().with_postmortem("")
+        st = ReadsStorage.make_default().postmortem_dir(str(tmp_path))
+        assert st._options.postmortem_dir == str(tmp_path)
+        # env knob resolves on configure
+        os.environ["DISQ_TPU_POSTMORTEM_DIR"] = str(tmp_path / "env")
+        try:
+            flightrec.configure_from_options(DisqOptions())
+            rec = flightrec.recorder()
+            assert rec is not None
+            assert rec.postmortem_dir == str(tmp_path / "env")
+        finally:
+            del os.environ["DISQ_TPU_POSTMORTEM_DIR"]

@@ -751,6 +751,37 @@ class TestSurfacing:
         assert doc["resilience"]["breakers"]["http"]["state"] == "open"
         assert doc["status"] == "degraded"
 
+    def test_telemetry_report_resilience_rollup(self, bam_file):
+        """``telemetry_report()`` carries a ``"resilience"`` key
+        mirroring the PR-6 ``"device"`` rollup: every hedge/breaker/
+        budget/deadline metric series pulled out of the full snapshot,
+        so the closed-loop story reads at a glance."""
+        from disq_tpu.runtime.resilience import (
+            breaker_for,
+            configure_breakers,
+        )
+        from disq_tpu.runtime.tracing import counter
+
+        path, _records, _data = bam_file
+        budget = configure_budget(50)
+        configure_breakers(4, 1.0)
+        assert budget.try_spend(what="test")       # budget.spent books
+        breaker_for("file:///x")                   # breaker exists
+        counter("hedge.launched").inc()            # hedge series books
+        ds = ReadsStorage.make_default().split_size(SPLIT).read(path)
+        report = ds.telemetry_report()
+        roll = report["resilience"]
+        assert roll, "resilience rollup empty with budget+breaker armed"
+        prefixes = {name.split(".", 1)[0] for name in roll}
+        assert prefixes <= {"hedge", "breaker", "budget", "deadline"}
+        assert "budget.spent" in roll
+        assert "hedge.launched" in roll
+        # The rollup is a *view* of the snapshot, not a parallel count.
+        for name, series in roll.items():
+            found = any(
+                name in kind for kind in report["metrics"].values())
+            assert found, f"{name} in rollup but not in metrics"
+
     def test_disabled_options_build_no_manager(self):
         assert resilience_for_options(DisqOptions()) is None
 
