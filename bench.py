@@ -619,6 +619,80 @@ def device_service_config(path: str) -> dict:
     return {"9_device_service_inflate": rows}
 
 
+def resident_decode_config(path: str) -> dict:
+    """Config 10: HBM-resident fused decode (inflate → parse →
+    flagstat, ``runtime/columnar.py``) against the PR8 split path —
+    real chip only.
+
+    Split path = device inflate → blob d2h → host ``decode_records``
+    → flagstat with its own flag re-upload. Fused path =
+    ``inflate_blocks_device(..., to_columnar=...)``: the SIMD kernel's
+    still-resident output is parsed in place and flagstat consumes the
+    resident flag column. Each row carries a ``d2h_bytes`` column
+    sourced from ``device.bytes_to_host`` registry deltas (and the
+    fused row ``d2h_avoided_bytes`` from ``device.d2h_avoided_bytes``)
+    so the transfer win is measured, not inferred."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from disq_tpu.bam.codec import decode_records, scan_record_offsets
+    from disq_tpu.bam.source import read_header
+    from disq_tpu.bgzf.codec import inflate_blocks_device
+    from disq_tpu.bgzf.guesser import find_block_table
+    from disq_tpu.fsw import PosixFileSystemWrapper
+    from disq_tpu.ops.flagstat import flagstat_counts
+    from disq_tpu.runtime.tracing import REGISTRY
+
+    fs = PosixFileSystemWrapper()
+    header, first_vo = read_header(fs, path)
+    blocks = [b for b in find_block_table(fs, path) if b.usize > 0]
+    with open(path, "rb") as f:
+        data = f.read()
+    total = sum(b.usize for b in blocks)
+    # first record's offset inside the decoded blob: cumulative usize
+    # of blocks before its block + the in-block offset
+    co, uo = first_vo >> 16, first_vo & 0xFFFF
+    lo_u = sum(b.usize for b in blocks if b.pos < co) + uo
+    d2h = REGISTRY.counter("device.bytes_to_host")
+    avoided = REGISTRY.counter("device.d2h_avoided_bytes")
+
+    def split_path():
+        blob = inflate_blocks_device(data, blocks, as_array=True)
+        rec = blob[lo_u:]
+        batch = decode_records(rec, scan_record_offsets(rec),
+                               n_ref=header.n_ref)
+        return flagstat_counts(np.asarray(batch.flag))
+
+    def fused_path():
+        batch = inflate_blocks_device(
+            data, blocks, to_columnar={"n_ref": header.n_ref,
+                                       "lo_u": lo_u})
+        stats = batch.flagstat()
+        batch.release()
+        return stats
+
+    out: dict = {}
+    n_rec = None
+    for name, fn in (("split", split_path), ("fused", fused_path)):
+        stats = fn()  # warm (compile caches)
+        n_rec = stats["total"]
+        d0, a0 = d2h.total(), avoided.total()
+        med, times = _timed(fn, 3)
+        out[name] = {
+            "mb_per_sec": round(total / med / 1e6, 2),
+            "records_per_sec": round(n_rec / med, 1),
+            "spread": _spread(times),
+            "d2h_bytes": int((d2h.total() - d0) / len(times)),
+        }
+        if name == "fused":
+            out[name]["d2h_avoided_bytes"] = int(
+                (avoided.total() - a0) / len(times))
+    out["fused_vs_split"] = round(
+        out["fused"]["mb_per_sec"] / out["split"]["mb_per_sec"], 3)
+    return {"10_resident_decode": out}
+
+
 def main() -> None:
     # DISQ_TPU_POSTMORTEM_DIR arms the flight recorder for the whole
     # bench: any abort writes a postmortem bundle there, and
@@ -684,6 +758,7 @@ def main() -> None:
     configs.update(write_scaling_config(path, tmp, max(2, REPS - 2)))
     configs.update(device_inflate_config(path))
     configs.update(device_service_config(path))
+    configs.update(resident_decode_config(path))
 
     # Telemetry snapshot accumulated across every config above
     # (runtime/tracing.py): phase totals + p50/p99, labeled counters

@@ -45,6 +45,7 @@ from disq_tpu.api import (  # noqa: F401
 from disq_tpu.runtime import (  # noqa: F401
     BreakerOpenError,
     ClusterAggregator,
+    ColumnarBatch,
     CorruptBlockError,
     DeadlineExceededError,
     DisqOptions,

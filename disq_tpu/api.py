@@ -213,10 +213,18 @@ class ReadsDataset:
         upload each; optionally placed with a ``NamedSharding``) — the
         HBM-resident shard-buffer form the device kernels consume
         (``runtime/device_pipeline``, ``ops/flagstat``, ``ops/depth``).
-        Ragged byte columns stay host-side (their device movement is
-        the sort exchange's padded-matrix path)."""
+        A dataset read through the fused resident-decode path already
+        IS device-backed (``runtime/columnar.ColumnarBatch``): its
+        columns are returned as-is, zero transfers. Ragged byte
+        columns stay host-side (their device movement is the sort
+        exchange's padded-matrix path)."""
         import jax
 
+        from disq_tpu.runtime.columnar import ColumnarBatch
+
+        if (sharding is None and isinstance(self.reads, ColumnarBatch)
+                and self.reads.device_backed):
+            return self.reads.device_columns()
         cols = {}
         for name in ("refid", "pos", "mapq", "flag", "bin",
                      "next_refid", "next_pos", "tlen"):
@@ -229,9 +237,15 @@ class ReadsDataset:
 
     def flagstat(self, mesh=None, axis: str = "shards") -> dict:
         """Per-category read counts (``samtools flagstat`` equivalent),
-        computed on device; with a mesh, sharded + psum-reduced."""
+        computed on device; with a mesh, sharded + psum-reduced. A
+        resident-decode dataset consumes its device flag column
+        directly — no h2d re-upload, d2h is the 48-byte row."""
         from disq_tpu.ops.flagstat import flagstat_counts
+        from disq_tpu.runtime.columnar import ColumnarBatch
 
+        if (mesh is None and isinstance(self.reads, ColumnarBatch)
+                and self.reads.device_backed):
+            return self.reads.flagstat()
         return flagstat_counts(np.asarray(self.reads.flag), mesh=mesh, axis=axis)
 
     def depth(self, window: int = 1024) -> dict:
@@ -468,6 +482,20 @@ class ReadsStorage:
         self._options = self._options.with_profile(hz)
         return self
 
+    def resident_decode(self, enable: bool = True) -> "ReadsStorage":
+        """Arm the HBM-resident fused decode path
+        (``runtime/columnar.py``): each shard's decoded blob is parsed
+        into a device-backed ``ColumnarBatch`` in the same launch
+        chain as the device codecs (with ``DISQ_TPU_DEVICE_INFLATE``
+        the SIMD kernel's still-resident output is parsed in place —
+        no re-upload), fixed columns stay in HBM, and d2h happens
+        lazily per column (``device.d2h_avoided_bytes`` books what
+        never moved). ``flagstat()`` / coordinate sort / interval
+        reads consume the resident columns directly. Env equivalent:
+        ``DISQ_TPU_RESIDENT_DECODE``."""
+        self._options = self._options.with_resident_decode(enable)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -633,6 +661,14 @@ class VariantsStorage:
     def profile_hz(self, hz: float) -> "VariantsStorage":
         """See ``ReadsStorage.profile_hz``."""
         self._options = self._options.with_profile(hz)
+        return self
+
+    def resident_decode(self, enable: bool = True) -> "VariantsStorage":
+        """See ``ReadsStorage.resident_decode``. Today only the BAM
+        read path builds resident batches; the knob is accepted here so
+        option sets stay interchangeable across storages (the variant
+        columnar currency is ROADMAP item 4's port)."""
+        self._options = self._options.with_resident_decode(enable)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

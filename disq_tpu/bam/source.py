@@ -99,9 +99,15 @@ class BamSource:
             return ReadsDataset(header=header, reads=batch,
                                 counters=counters)
         with trace_phase("bam.read.splits"):
+            from disq_tpu.runtime.columnar import concat_batches
+
             batches = self.read_split_batches(
                 fs, path, header, first_voffset, ctx=ctx)
-            batch = ReadBatch.concat(batches)
+            # all-resident shards concatenate ON DEVICE and the dataset
+            # stays a device-backed ColumnarBatch (lazy d2h per column);
+            # any host shard (salvage paths, disabled knob) materializes
+            # the whole read host-side exactly as before
+            batch = concat_batches(batches)
         if debug_enabled():
             check_read_batch(batch, n_ref=header.n_ref)
         counters = reduce_counters(self._last_counters)
@@ -525,7 +531,17 @@ class BamSource:
         fault-free fast path is the one batched inflate below; only when
         it fails does the per-block salvage path run, applying the
         policy (strict raise with coordinates / skip / quarantine).
+
+        With resident decode on (``DisqOptions.resident_decode`` /
+        ``DISQ_TPU_RESIDENT_DECODE``) the fault-free fast path parses
+        the shard into a device-backed ``ColumnarBatch`` in the same
+        launch chain as the device codecs — when the SIMD inflate
+        kernel decoded the blocks, its still-HBM-resident output is
+        parsed in place (no re-upload).  Every salvage/tolerant path
+        stays host-side, so error semantics (and owner-shard
+        quarantine accounting) are identical.
         """
+        from disq_tpu.runtime.columnar import resident_decode_enabled
         from disq_tpu.runtime.errors import inflate_blocks_salvage
 
         if fetched is None:
@@ -564,8 +580,21 @@ class BamSource:
                 lo_u, hi_block, hi_u, ctx=ctx,
             )
             return batch, stats
+        resident = resident_decode_enabled(self._storage)
+        # the device parse indexes with i32: a (pathological) >=2 GiB
+        # decoded shard silently demotes to the host path instead of
+        # tripping the corruption handler on valid data
+        if resident and sum(b.usize for b in blocks) >= 2 ** 31:
+            resident = False
+        dev_handle = None
         try:
-            blob = inflate_blocks(data, blocks, base=lo_block, as_array=True)
+            if resident:
+                blob, dev_handle = inflate_blocks(
+                    data, blocks, base=lo_block, as_array=True,
+                    keep_device=True)
+            else:
+                blob = inflate_blocks(
+                    data, blocks, base=lo_block, as_array=True)
         except ValueError as first_err:
             # At least one block is corrupt: per-block salvage under the
             # policy (STRICT raises CorruptBlockError with coordinates).
@@ -588,8 +617,22 @@ class BamSource:
         record_bytes = blob[lo_u:end_u]
         try:
             offsets = scan_record_offsets(record_bytes)
-            batch = decode_records(record_bytes, offsets, n_ref=header.n_ref)
+            if resident:
+                from disq_tpu.runtime.columnar import ColumnarBatch
+
+                words = (dev_handle.assemble()
+                         if dev_handle is not None else None)
+                dev_handle = None
+                batch = ColumnarBatch.from_blob(
+                    record_bytes, offsets, n_ref=header.n_ref,
+                    device_words=words, origin=lo_u)
+            else:
+                batch = decode_records(
+                    record_bytes, offsets, n_ref=header.n_ref)
         except ValueError as e:
+            if dev_handle is not None:
+                dev_handle.release()
+                dev_handle = None
             # Record framing/content damage inside intact BGZF blocks
             # (corruption that predates compression, so no single block
             # is identifiable): STRICT raises with the shard's

@@ -74,6 +74,7 @@ def inflate_block(data: bytes, offset: int = 0, verify_crc: bool = True) -> byte
 def inflate_blocks(
     data: bytes, blocks: Sequence[BgzfBlock], base: int = 0,
     verify_crc: bool = True, as_array: bool = False,
+    keep_device: bool = False,
 ):
     """Inflate many blocks from a staged buffer. ``base`` is the file
     offset at which ``data[0]`` sits, so ``BgzfBlock.pos`` (absolute)
@@ -86,28 +87,37 @@ def inflate_blocks(
     (``disq_tpu.ops.inflate_simd`` — the device path; CRC checked on
     host), or ``=legacy`` for the round-1 scalar kernel
     (``disq_tpu.ops.inflate``).
+
+    ``keep_device`` changes the return to ``(blob, handle)``: on the
+    direct SIMD device path the handle is the still-HBM-resident
+    kernel output (``DeviceBlobHandle``) the fused resident-decode
+    chain parses without re-uploading; every other route returns
+    ``(blob, None)`` and the caller falls back to one upload.
     """
     import numpy as np
 
     if not blocks:
-        return np.empty(0, dtype=np.uint8) if as_array else b""
+        empty = np.empty(0, dtype=np.uint8) if as_array else b""
+        return (empty, None) if keep_device else empty
     from disq_tpu.runtime.debug import env_flag
     from disq_tpu.runtime.tracing import span
 
     with span("codec.inflate.batch", blocks=len(blocks)):
         return _inflate_blocks_timed(
-            data, blocks, base, verify_crc, as_array, env_flag)
+            data, blocks, base, verify_crc, as_array, env_flag,
+            keep_device)
 
 
 def _inflate_blocks_timed(data, blocks, base, verify_crc, as_array,
-                          env_flag):
+                          env_flag, keep_device=False):
     import numpy as np
 
     if env_flag("DISQ_TPU_DEVICE_INFLATE"):
         # as_array flows through: the SIMD path assembles the blob
         # straight from the kernel's transposed output (no bytes join)
         return inflate_blocks_device(
-            data, blocks, base, verify_crc=verify_crc, as_array=as_array)
+            data, blocks, base, verify_crc=verify_crc,
+            as_array=as_array, keep_device=keep_device)
     try:
         from disq_tpu.native import inflate_blocks_native
 
@@ -119,22 +129,25 @@ def _inflate_blocks_timed(data, blocks, base, verify_crc, as_array,
         xlen = arr[off + 10].astype(np.int32) | (
             arr[off + 11].astype(np.int32) << 8
         )
-        return inflate_blocks_native(
+        out = inflate_blocks_native(
             arr, off, 12 + xlen, csize, usize, verify_crc=verify_crc,
             as_array=as_array,
         )
+        return (out, None) if keep_device else out
     except ImportError:
         pass
     parts = [
         inflate_block(data, b.pos - base, verify_crc=verify_crc) for b in blocks
     ]
     out = b"".join(parts)
-    return np.frombuffer(out, dtype=np.uint8) if as_array else out
+    out = np.frombuffer(out, dtype=np.uint8) if as_array else out
+    return (out, None) if keep_device else out
 
 
 def inflate_blocks_device(
     data: bytes, blocks: Sequence[BgzfBlock], base: int = 0,
     verify_crc: bool = True, as_array: bool = False,
+    keep_device: bool = False, to_columnar=None,
 ):
     """Device path of ``inflate_blocks``: the 128-lane SIMD Pallas
     kernel (``ops/inflate_simd``, the PROBES.md design) with ISIZE
@@ -151,13 +164,33 @@ def inflate_blocks_device(
     compressed bytes); batch CRC verification runs threaded, off the
     kernel's critical path (the service keeps decoding other shards'
     chunks while this thread verifies).  ``as_array`` returns the blob
-    as a uint8 array instead of bytes."""
+    as a uint8 array instead of bytes.
+
+    ``keep_device`` returns ``(blob, DeviceBlobHandle-or-None)``: on
+    the direct SIMD path the kernel's output chunks stay resident in
+    HBM for the fused parse chain (service/legacy routes hand back
+    None — their outputs live in the owner submissions' host blobs).
+
+    ``to_columnar`` is the fused inflate → parse → columnar route
+    (ROADMAP item 1): a ``{"n_ref": …, "lo_u": …, "end_u": …}`` spec
+    makes this call return a device-backed
+    ``runtime/columnar.ColumnarBatch`` parsed in the same launch chain
+    — record offsets are scanned on the host copy (which CRC
+    verification requires anyway), but the decoded payload bytes are
+    parsed where the inflate kernel left them and the fixed columns
+    stay in HBM until fetched."""
     import os
 
     import numpy as np
 
     if not blocks:
-        return np.empty(0, dtype=np.uint8) if as_array else b""
+        if to_columnar is not None:
+            from disq_tpu.runtime.columnar import ColumnarBatch
+            from disq_tpu.bam.columnar import ReadBatch
+
+            return ColumnarBatch.from_host(ReadBatch.empty())
+        empty = np.empty(0, dtype=np.uint8) if as_array else b""
+        return (empty, None) if keep_device else empty
     legacy = os.environ.get(
         "DISQ_TPU_DEVICE_INFLATE", "").lower() == "legacy"
     mv = memoryview(data)
@@ -168,6 +201,8 @@ def inflate_blocks_device(
         p = mv[off + 12 + xlen: off + b.csize - BGZF_FOOTER_SIZE]
         payloads.append(bytes(p) if legacy else p)
     usizes = [b.usize for b in blocks]
+    want_handle = keep_device or to_columnar is not None
+    handle = None
     if legacy:
         from disq_tpu.ops.inflate import inflate_payloads
         from disq_tpu.ops.inflate_simd import assemble_blob
@@ -183,11 +218,48 @@ def inflate_blocks_device(
         else:
             from disq_tpu.ops.inflate_simd import inflate_payloads_simd
 
-            blob, offsets = inflate_payloads_simd(
-                payloads, usizes=usizes, as_array=True)
-    if verify_crc:
-        _verify_block_crcs(data, blocks, base, blob, offsets)
+            if want_handle:
+                blob, offsets, handle = inflate_payloads_simd(
+                    payloads, usizes=usizes, as_array=True,
+                    keep_device=True)
+            else:
+                blob, offsets = inflate_payloads_simd(
+                    payloads, usizes=usizes, as_array=True)
+    try:
+        if verify_crc:
+            _verify_block_crcs(data, blocks, base, blob, offsets)
+    except BaseException:
+        if handle is not None:
+            handle.release()
+        raise
+    if to_columnar is not None:
+        return _blob_to_columnar(blob, handle, to_columnar)
+    if keep_device:
+        return (blob if as_array else blob.tobytes()), handle
     return blob if as_array else blob.tobytes()
+
+
+def _blob_to_columnar(blob, handle, spec):
+    """The parse half of the ``to_columnar`` route: scan the record
+    chain on the host copy, then parse the device-resident blob into a
+    ``ColumnarBatch`` (re-uploading only when no kernel output stayed
+    on device)."""
+    from disq_tpu.bam.codec import scan_record_offsets
+    from disq_tpu.runtime.columnar import ColumnarBatch
+
+    lo_u = int(spec.get("lo_u", 0))
+    end_u = spec.get("end_u")
+    rec = blob[lo_u: len(blob) if end_u is None else int(end_u)]
+    try:
+        rec_offsets = scan_record_offsets(rec)
+    except BaseException:
+        if handle is not None:
+            handle.release()
+        raise
+    words = handle.assemble() if handle is not None else None
+    return ColumnarBatch.from_blob(
+        rec, rec_offsets, n_ref=spec.get("n_ref"),
+        device_words=words, origin=lo_u)
 
 
 def _verify_block_crcs(data, blocks, base, blob, offsets) -> None:

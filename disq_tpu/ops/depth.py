@@ -34,6 +34,13 @@ def window_depth(
     """Per-reference windowed depth from a columnar batch (mapped
     records only). Returns {refid: int32 array of window depths}.
 
+    ``batch`` may be a host ``ReadBatch`` or a resident
+    ``runtime/columnar.ColumnarBatch`` — the window math consumes the
+    lazily-fetched refid/pos/flag columns plus the cigar-derived
+    alignment ends (host-side by nature), so a resident dataset pays
+    d2h only for the three columns this op actually reads, never a
+    record re-upload.
+
     All references share ONE concatenated window space (per-ref window
     offsets), so the whole call is a single scatter+cumsum dispatch —
     one compile regardless of how many contigs the dictionary has.

@@ -62,6 +62,38 @@ def _flagstat_single(flag: jax.Array) -> jax.Array:
     return _counts(flag, jnp.ones(flag.shape, jnp.int32))
 
 
+@jax.jit
+def _flagstat_masked(flag: jax.Array, n) -> jax.Array:
+    """``_counts`` over the first ``n`` entries of a (possibly
+    bucket-padded) device flag column — the resident-batch form, where
+    padded tail entries duplicate a real record and must not count."""
+    valid = (jnp.arange(flag.shape[0]) < n).astype(jnp.int32)
+    return _counts(flag.astype(jnp.int32), valid)
+
+
+def flagstat_resident(flag_dev, n: int) -> Dict[str, int]:
+    """flagstat straight from a device-resident flag column
+    (``runtime/columnar.ColumnarBatch``): zero h2d — the split path's
+    re-upload of the flag column is exactly what the fused decode
+    avoids — and d2h is the 48-byte count row."""
+    from disq_tpu.runtime.tracing import count_transfer, device_span
+
+    import jax as _jax
+
+    # the record-count scalar is staged OUTSIDE the guard (it is the
+    # only non-resident operand; 4 bytes)
+    n_dev = jnp.asarray(np.int32(n))
+    with device_span("device.kernel", kernel="flagstat",
+                     records=int(n)) as fence:
+        with _jax.transfer_guard("disallow"):
+            out = _flagstat_masked(flag_dev, n_dev)
+            _jax.block_until_ready(out)
+        fence.sync(out)
+    row = np.asarray(out)
+    count_transfer("d2h", row.nbytes)
+    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}
+
+
 def flagstat_counts(
     flag: np.ndarray, mesh: Optional[Mesh] = None, axis: str = "shards"
 ) -> Dict[str, int]:

@@ -887,11 +887,7 @@ def _compiled(cw: int, ow: int, interpret: bool,
     return jax.jit(call, donate_argnums=nums)
 
 
-def _bucket(n: int, lo: int = 64) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+from disq_tpu.util import bucket_pow2 as _bucket  # noqa: E402 — shared policy
 
 
 # ---------------------------------------------------------------------------
@@ -1091,6 +1087,69 @@ def _finalize_lane(p, lanes_u8, meta, j: int, expect: Optional[int]):
     return lanes_u8[j, :n]
 
 
+class DeviceBlobHandle:
+    """The still-resident decoded output of one
+    ``inflate_payloads_simd(keep_device=True)`` call: the kernel's
+    transposed (LANES, ow) output chunks, kept alive on device, plus
+    the per-block lane map and host-fallback patch bytes.
+
+    ``assemble()`` compacts them into one contiguous device word blob
+    (``runtime/device_pipeline.assemble_device_words`` — a per-byte
+    gather entirely on device), so the fused parse chain reads the
+    decoded shard where the inflate kernel left it instead of
+    re-uploading the d2h'd host copy. The handle owns the chunks' HBM
+    accounting; assemble/release drops them."""
+
+    def __init__(self, n_blocks: int, offsets: np.ndarray) -> None:
+        self.chunks: List[Any] = []
+        self.lane_of = np.full(n_blocks, -1, np.int64)
+        self.offsets = offsets
+        self.patches: List[Any] = []
+        self._hbm = 0
+        self._released = False
+
+    def add_chunk(self, words) -> int:
+        """Retain one chunk's device output; returns its index."""
+        self.chunks.append(words)
+        nbytes = int(words.size) * 4
+        self._hbm += nbytes
+        _track_hbm(nbytes)
+        return len(self.chunks) - 1
+
+    def assemble(self):
+        """Device word blob covering every block (host-fallback lanes
+        patched from a small upload), or None when nothing stayed on
+        device. Releases the retained chunks either way."""
+        if self._released:
+            return None
+        if not self.chunks:
+            self.release()
+            return None
+        from disq_tpu.runtime.device_pipeline import assemble_device_words
+
+        try:
+            words, _up = assemble_device_words(
+                self.chunks, self.lane_of, self.offsets, self.patches)
+        finally:
+            self.release()
+        return words
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self.chunks = []
+        if self._hbm:
+            _track_hbm(-self._hbm)
+            self._hbm = 0
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing
+        try:
+            self.release()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+
 def assemble_blob(results: Sequence):
     """Compact per-payload results (uint8 views / fallback bytes) into
     one contiguous uint8 blob + (n+1,) int64 offsets with plain
@@ -1113,6 +1172,7 @@ def inflate_payloads_simd(
     usizes: Optional[Sequence[int]] = None,
     interpret: Optional[bool] = None,
     as_array: bool = False,
+    keep_device: bool = False,
 ):
     """Inflate raw-DEFLATE payloads on the 128-lane SIMD kernel.
 
@@ -1124,6 +1184,12 @@ def inflate_payloads_simd(
     to adjudicate, surfaced as ``ValueError`` (the framework's
     corrupt-input contract).  Payloads may be ``memoryview`` slices.
 
+    ``keep_device`` (requires ``as_array`` + known usizes) additionally
+    returns a ``DeviceBlobHandle`` as a third element: the kernel's
+    output chunks stay resident in HBM so the fused resident-decode
+    path (``runtime/columnar.ColumnarBatch``) can parse the shard
+    without re-uploading the blob; None when no lane stayed on device.
+
     Dispatch path (this PR's shape): staging arenas from the process
     pool instead of fresh numpy buffers, device-resident constant
     tables (``_device_const_tables``), donated per-chunk uploads, and
@@ -1131,10 +1197,12 @@ def inflate_payloads_simd(
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    keep_device = keep_device and as_array and usizes is not None
     n = len(payloads)
     if n == 0:
         if as_array:
-            return np.empty(0, np.uint8), np.zeros(1, np.int64)
+            empty = np.empty(0, np.uint8), np.zeros(1, np.int64)
+            return (*empty, None) if keep_device else empty
         return []
     # VMEM budget (~16 MB/core): comp (8192,128) u32 = 4 MB + out
     # (16384,128) u32 = 8 MB + tables/ring ~1.2 MB fits because the
@@ -1146,12 +1214,14 @@ def inflate_payloads_simd(
     # materializes, so no chunk's (LANES, ow*4) buffer outlives its
     # loop iteration (holding per-lane views would pin every chunk of
     # a large call in memory at once).
-    blob = offsets = None
+    blob = offsets = dev_handle = None
     if as_array and usizes is not None:
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(np.asarray([int(u) for u in usizes], np.int64),
                   out=offsets[1:])
         blob = np.empty(int(offsets[-1]), dtype=np.uint8)
+        if keep_device:
+            dev_handle = DeviceBlobHandle(n, offsets)
 
     def emit(i: int, val) -> None:
         if blob is not None:
@@ -1171,8 +1241,11 @@ def inflate_payloads_simd(
         if len(p) > MAX_DEVICE_CSIZE:
             last_stats["host_big"] += 1
             _counter("device.host_fallback_blocks").inc(reason="oversize")
-            emit(i, host_inflate(
-                p, None if usizes is None else int(usizes[i])))
+            val = host_inflate(
+                p, None if usizes is None else int(usizes[i]))
+            emit(i, val)
+            if dev_handle is not None:
+                dev_handle.patches.append((i, val))
         else:
             small.append(i)
     if small:
@@ -1212,12 +1285,27 @@ def inflate_payloads_simd(
                 # materialized => the upload was consumed; the arena is
                 # safe to repack for a later chunk
                 ARENAS.release(("inflate", cw), arena)
+                lane_base = -1
+                if dev_handle is not None:
+                    # retain the chunk's device output: the decoded
+                    # bytes stay in HBM for the fused parse chain
+                    lane_base = dev_handle.add_chunk(handle[0]) * LANES
                 if ci + window < len(chunks):
                     launched.append(launch(chunks[ci + window]))
                 for j, i in enumerate(ids):
                     expect = None if usizes is None else int(usizes[i])
-                    emit(i, _finalize_lane(
-                        payloads[i], lanes_u8, meta, j, expect))
+                    val = _finalize_lane(
+                        payloads[i], lanes_u8, meta, j, expect)
+                    emit(i, val)
+                    if dev_handle is not None:
+                        if isinstance(val, np.ndarray):
+                            dev_handle.lane_of[i] = lane_base + j
+                        else:  # host re-inflate: patch on assembly
+                            dev_handle.patches.append((i, val))
+        except BaseException:
+            if dev_handle is not None:
+                dev_handle.release()
+            raise
         finally:
             _track_hbm(-hbm_scope)
             # an abandoned window (corrupt lane raised mid-loop) must
@@ -1227,6 +1315,8 @@ def inflate_payloads_simd(
                 if entry is not None:
                     ARENAS.release(("inflate", cw), entry[1])
     if blob is not None:
+        if keep_device:
+            return blob, offsets, dev_handle
         return blob, offsets
     if as_array:
         return assemble_blob(results)

@@ -431,7 +431,9 @@ def run_device_pipeline_row(results: list) -> None:
     best = 1e9
     for _ in range(3):
         t0 = time.perf_counter()
-        run_device_pipeline(blob, offs, interpret=False)
+        # unpack: the result fetch is lazy now — materializing keeps
+        # this row's timing covering upload + kernels + results d2h
+        _k, _o, _s = run_device_pipeline(blob, offs, interpret=False)
         best = min(best, time.perf_counter() - t0)
     results.append({
         "kernel": "device_pipeline_parse_sort_flagstat",

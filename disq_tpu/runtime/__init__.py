@@ -152,6 +152,12 @@ from disq_tpu.runtime.profiler import (  # noqa: F401
     start_profiler,
     stop_profiler,
 )
+from disq_tpu.runtime.columnar import (  # noqa: F401
+    ColumnarBatch,
+    as_read_batch,
+    concat_batches,
+    resident_decode_enabled,
+)
 from disq_tpu.runtime.manifest import (  # noqa: F401
     QuarantineManifest,
     ReadLedger,

@@ -1,0 +1,652 @@
+"""ColumnarBatch — the host-or-device columnar record batch, the
+universal currency between sources, device ops, and sinks.
+
+ROADMAP item 1 ("HBM-resident fused decode"): the split decode path
+inflates on device, ships the decoded blob d2h, re-parses every record
+on host, and re-uploads whichever columns a device op wants — the
+round-trip that pins device e2e at ~7.7 MB/s against a far higher
+kernel ceiling. ``ColumnarBatch`` removes it: the fused path parses
+the decoded blob into fixed columns **on device, in the same launch
+chain as the inflate kernels** (``runtime/device_pipeline.
+parse_columns_resident``; when the SIMD inflate ran, its still-resident
+output chunks are compacted in HBM by ``assemble_device_words`` so the
+payload bytes never round-trip), and the parsed columns stay resident:
+
+- **Lazy d2h.** Attribute access (``batch.pos``, ``batch.flag``, …)
+  fetches that one column, once — repeated access returns the host
+  cache, so ``device.transfer`` bytes are never double-booked. Columns
+  a caller never touches never cross d2h; their bytes (and columns
+  consumed on device) are booked into ``device.d2h_avoided_bytes`` at
+  release — a later host fetch un-marks a consumed column first, so
+  nothing is ever counted both as moved and as avoided.
+- **Resident consumers.** ``flagstat()`` feeds the device flag column
+  straight into the flagstat kernel (zero h2d re-upload);
+  ``sort_permutation()`` builds coordinate keys and the lexsort
+  permutation on device and fetches only the (n,) i32 order — the u64
+  key vectors never move. ``ops/depth.py`` and every existing
+  ``ReadBatch`` consumer work unchanged through the lazy properties.
+- **Host interop.** Ragged columns (names / cigars / seqs / quals /
+  tags) come lazily from the host copy of the decoded blob (which the
+  read path holds anyway for CRC verification and the record-offset
+  scan); ``to_read_batch()`` / ``take()`` / ``concat()`` materialize a
+  plain ``ReadBatch`` when host-side work (sorting gathers, sinks)
+  needs it. ``concat`` of all-device batches stays device-backed.
+
+Enablement: ``DisqOptions.resident_decode`` /
+``ReadsStorage.resident_decode()`` / env ``DISQ_TPU_RESIDENT_DECODE``.
+Disabled (the default), sources return plain host ``ReadBatch`` objects
+and this module allocates nothing on device —
+``scripts/check_overhead.py`` asserts ``device_batches_built() == 0``
+on that path.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from disq_tpu.bam.columnar import ReadBatch
+from disq_tpu.util import bucket_pow2 as _bucket_n
+
+# The 12 fields the Pallas parse kernel emits (ops/parse._FIELD_ORDER);
+# the 8 ReadBatch fixed columns are a subset with narrowed dtypes.
+PARSE_FIELDS = (
+    "block_size", "refid", "pos", "l_read_name", "mapq", "bin",
+    "n_cigar", "flag", "l_seq", "next_refid", "next_pos", "tlen",
+)
+FIXED_COLUMNS = ("refid", "pos", "mapq", "bin", "flag",
+                 "next_refid", "next_pos", "tlen")
+_COL_DTYPE = {
+    "refid": np.int32, "pos": np.int32, "mapq": np.uint8,
+    "bin": np.uint16, "flag": np.uint16, "next_refid": np.int32,
+    "next_pos": np.int32, "tlen": np.int32,
+}
+_RAGGED = ("name_offsets", "names", "cigar_offsets", "cigars",
+           "seq_offsets", "seqs", "quals", "tag_offsets", "tags")
+
+
+_stats_lock = threading.Lock()
+_device_batches_built = 0
+_resident_live_bytes = 0
+
+
+def device_batches_built() -> int:
+    """Process-lifetime count of device-backed builds — the
+    check_overhead invariant: 0 whenever resident decode is off."""
+    with _stats_lock:
+        return _device_batches_built
+
+
+def _note_build(resident_delta: int) -> None:
+    global _device_batches_built, _resident_live_bytes
+    from disq_tpu.runtime.tracing import observe_gauge
+
+    with _stats_lock:
+        if resident_delta >= 0:
+            _device_batches_built += 1
+        _resident_live_bytes = max(
+            0, _resident_live_bytes + resident_delta)
+        live = _resident_live_bytes
+    observe_gauge("columnar.batch.resident_bytes", live)
+
+
+def resident_decode_enabled(storage) -> bool:
+    """True when the fused HBM-resident decode path is on for this
+    storage: ``DisqOptions.resident_decode`` or the
+    ``DISQ_TPU_RESIDENT_DECODE`` env knob."""
+    opts = getattr(storage, "_options", None)
+    if opts is not None and getattr(opts, "resident_decode", False):
+        return True
+    from disq_tpu.runtime.debug import env_flag
+
+    return env_flag("DISQ_TPU_RESIDENT_DECODE")
+
+
+@functools.lru_cache(maxsize=1)
+def _jax_fns():
+    """Lazily-built jitted helpers (this module must import without
+    jax on the disabled path)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def record_check(refid, next_refid, lrn, ncig, lseq, lens, n_ref):
+        # Eager corrupt-record detection mirroring the host parser
+        # (bam/codec.decode_records): impossible refIDs (when n_ref is
+        # known, i.e. >= 0) or record sections overflowing the record
+        # length. Restructured as slack comparisons so every term stays
+        # inside i32 (lens < 2^31 is guaranteed by the from_blob size
+        # guard). Returns one boolean — the only d2h of the check.
+        neg = lseq < 0
+        over = lseq > lens
+        lseq_c = jnp.clip(lseq, 0, lens)
+        head = 36 + lrn + 4 * ncig + (lseq_c + 1) // 2
+        bad = neg | over | (head > (lens - lseq_c))
+        refbad = ((refid >= n_ref) | (refid < -1)
+                  | (next_refid >= n_ref) | (next_refid < -1))
+        bad = bad | ((n_ref >= 0) & refbad)
+        return jnp.any(bad)
+
+    @jax.jit
+    def coord_perm(refid, pos, n):
+        # Coordinate keys + stable lexsort on device. Padded tail
+        # entries (the bucket-padded parse duplicates the last record)
+        # get a key above every real one — unmapped maps to 0x7FFFFFFF
+        # — so order[:n] is exactly the real permutation.
+        m = refid.shape[0]
+        valid = jnp.arange(m, dtype=jnp.int32) < n
+        rid = jnp.where(refid < 0, jnp.uint32(0x7FFFFFFF),
+                        refid.astype(jnp.uint32))
+        hi = jnp.where(valid, rid, jnp.uint32(0xFFFFFFFF))
+        lo = (pos + 1).astype(jnp.uint32)
+        return jnp.lexsort((lo, hi)).astype(jnp.int32)
+
+    return {"jax": jax, "jnp": jnp, "coord_perm": coord_perm,
+            "record_check": record_check}
+
+
+class ColumnarBatch:
+    """N alignment records with fixed columns resident on device (or a
+    thin wrapper over a host ``ReadBatch``). Duck-compatible with
+    ``ReadBatch``: every column attribute returns host numpy (lazily
+    fetched, cached), so existing consumers work unchanged while
+    device ops consume the resident columns without re-upload."""
+
+    def __init__(self) -> None:
+        # built via from_blob / from_host — never directly
+        self._n = 0
+        self._dev: Optional[Dict[str, object]] = None
+        self._blob: Optional[np.ndarray] = None
+        self._blob_parts: Optional[List[np.ndarray]] = None
+        self._offsets: Optional[np.ndarray] = None
+        self._n_ref: Optional[int] = None
+        self._cache: Dict[str, np.ndarray] = {}
+        self._consumed: Dict[str, int] = {}
+        self._ragged_rb: Optional[ReadBatch] = None
+        self._rb: Optional[ReadBatch] = None
+        self._hbm = 0
+        self._released = False
+        # lazy state is shared across threads (writer pipeline workers
+        # slice the same dataset batch concurrently): the lock makes
+        # each lazy build/fetch happen once — unlocked, W workers
+        # would each host-parse the whole blob and concurrent fetches
+        # of one column would double-book device.transfer
+        self._lock = threading.RLock()
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_host(cls, batch: ReadBatch) -> "ColumnarBatch":
+        self = cls()
+        self._n = batch.count
+        self._rb = batch
+        self._ragged_rb = batch
+        return self
+
+    @classmethod
+    def from_blob(
+        cls,
+        blob: np.ndarray,
+        offsets: np.ndarray,
+        n_ref: Optional[int] = None,
+        device_words=None,
+        origin: int = 0,
+        interpret: Optional[bool] = None,
+    ) -> "ColumnarBatch":
+        """Fused device build: one upload (skipped when
+        ``device_words`` carries the inflate kernels' still-resident
+        output) + one gather/parse launch chain; fixed columns stay in
+        HBM until fetched or released.
+
+        ``blob``/``offsets`` are the host record bytes + record-offset
+        manifest (held for ragged columns and identity with the host
+        parser); ``origin`` rebases the offsets into ``device_words``
+        when that blob covers more than the record range."""
+        from disq_tpu.runtime.device_pipeline import parse_columns_resident
+        from disq_tpu.runtime.tracing import span
+
+        n = len(offsets) - 1
+        if n <= 0:
+            return cls.from_host(ReadBatch.empty())
+        if interpret is None:
+            jx = _jax_fns()["jax"]
+            interpret = jx.default_backend() != "tpu"
+        self = cls()
+        self._n = n
+        self._blob = blob
+        self._offsets = np.asarray(offsets, dtype=np.int64)
+        self._n_ref = n_ref
+        with span("columnar.batch.build", records=n,
+                  bytes=int(offsets[-1])):
+            # origin rebases offsets into a full-shard device blob;
+            # the upload fallback stages exactly the record slice, so
+            # its offsets are already correct
+            cols, _word_bytes, _ = parse_columns_resident(
+                blob, self._offsets, words_dev=device_words,
+                origin=origin if device_words is not None else 0,
+                interpret=interpret)
+            # keep only the 8 reachable fixed columns resident (plus
+            # next_refid for validation below); the 4 parse-only
+            # length fields are derivable from the ragged offsets and
+            # would pin 50% extra HBM with no consumer
+            self._dev = {k: cols[k] for k in FIXED_COLUMNS}
+        # Residency: the fixed columns (bucket-padded i32). The word
+        # blob itself is released with the launch chain — nothing
+        # downstream reads it on device (ragged comes from the host
+        # copy the CRC/scan already required).
+        padded = int(cols["pos"].shape[0])
+        self._hbm = len(self._dev) * padded * 4
+        from disq_tpu.runtime.tracing import track_hbm
+
+        track_hbm(self._hbm)
+        _note_build(self._hbm)
+        # same eager corrupt-record contract as decode_records: a
+        # chain-valid shard with impossible refIDs OR record sections
+        # overflowing their record (the host parser's "sections exceed
+        # block_size" bound) must fail HERE, so the source's
+        # except-ValueError salvage path applies exactly as on the host
+        # route. The check is a device reduction — one boolean crosses
+        # d2h; padded lanes get a maximal record length so they never
+        # flag.
+        from disq_tpu.runtime.tracing import count_transfer
+
+        rec_len = np.empty(padded, np.int32)
+        rec_len[:n] = self._offsets[1:] - self._offsets[:-1]
+        rec_len[n:] = np.iinfo(np.int32).max
+        count_transfer("h2d", rec_len.nbytes)
+        fns = _jax_fns()
+        bad = fns["record_check"](
+            cols["refid"], cols["next_refid"], cols["l_read_name"],
+            cols["n_cigar"], cols["l_seq"], rec_len,
+            np.int32(-1 if n_ref is None else n_ref))
+        if bool(bad):
+            self._release(book_avoided=False)
+            from disq_tpu.bam.codec import decode_records
+
+            # the host parser is the authority on the error (exact
+            # message + record coordinates); if the device predicate
+            # was somehow conservative, serve its host batch instead
+            host = decode_records(blob, self._offsets, n_ref=n_ref)
+            return cls.from_host(host)
+        return self
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def device_backed(self) -> bool:
+        return self._dev is not None
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    # -- lazy column access -------------------------------------------------
+
+    def _fetch_col(self, name: str) -> np.ndarray:
+        arr = self._cache.get(name)
+        if arr is not None:
+            return arr
+        with self._lock:
+            arr = self._cache.get(name)
+            if arr is not None:  # lost the race: fetched once, by them
+                return arr
+            if self._dev is None:
+                if self._rb is not None:
+                    return getattr(self._rb, name)
+                # released device columns, but the host blob is still
+                # held for ragged parsing — rebuild from it instead of
+                # failing only on fixed-column access
+                if self._blob is not None or self._blob_parts:
+                    return getattr(self._ragged_source(), name)
+                raise RuntimeError(
+                    f"column {name!r} of a released ColumnarBatch — "
+                    "fetch before release(), or keep the batch alive")
+            from disq_tpu.runtime.tracing import count_transfer, span
+
+            nbytes = 4 * self._n
+            with span("columnar.batch.fetch", column=name, bytes=nbytes):
+                raw = np.asarray(self._dev[name][: self._n])
+            count_transfer("d2h", raw.nbytes)
+            dt = _COL_DTYPE.get(name)
+            arr = (raw.astype(dt)
+                   if dt is not None and raw.dtype != dt else raw)
+            self._cache[name] = arr
+            # a column that DID cross d2h after all is no longer avoided
+            # — consumption marks are provisional until release books
+            # them
+            self._consumed.pop(name, None)
+            return arr
+
+    def _consume_on_device(self, key: str, nbytes: int) -> None:
+        """Mark a column (or derived result) consumed on device without
+        a host fetch — d2h the split path would have paid. Booked into
+        ``device.d2h_avoided_bytes`` at release (not here), so a later
+        host fetch of the same column un-marks it instead of
+        double-counting."""
+        with self._lock:
+            if key in self._consumed or key in self._cache:
+                return
+            self._consumed[key] = nbytes
+
+    # fixed columns (device-parsed; lazily fetched)
+    refid = property(lambda self: self._fetch_col("refid"))
+    pos = property(lambda self: self._fetch_col("pos"))
+    mapq = property(lambda self: self._fetch_col("mapq"))
+    bin = property(lambda self: self._fetch_col("bin"))
+    flag = property(lambda self: self._fetch_col("flag"))
+    next_refid = property(lambda self: self._fetch_col("next_refid"))
+    next_pos = property(lambda self: self._fetch_col("next_pos"))
+    tlen = property(lambda self: self._fetch_col("tlen"))
+
+    # -- ragged columns (host blob, parsed lazily once) ---------------------
+
+    def _host_blob(self) -> Optional[np.ndarray]:
+        """The record bytes as one host array, joining a concat's
+        per-shard parts on first need (under the instance lock)."""
+        with self._lock:
+            if self._blob is None and self._blob_parts is not None:
+                self._blob = np.concatenate(self._blob_parts)
+                self._blob_parts = None
+            return self._blob
+
+    def _ragged_source(self) -> ReadBatch:
+        if self._ragged_rb is None:
+            with self._lock:
+                if self._ragged_rb is None:
+                    from disq_tpu.bam.codec import decode_records
+
+                    self._ragged_rb = decode_records(
+                        self._host_blob(), self._offsets,
+                        n_ref=self._n_ref)
+        return self._ragged_rb
+
+    def __getattr__(self, name: str):
+        if name in _RAGGED:
+            return getattr(self._ragged_source(), name)
+        raise AttributeError(name)
+
+    # -- pickling (ReadLedger crash-resume spills) --------------------------
+
+    def __reduce__(self):
+        """Spill as HOST data, never as device arrays: pickling the
+        resident columns would be an uncounted implicit d2h, and the
+        restored copy would re-book their avoidance on release. A
+        device-backed batch spills its host blob + offsets and re-runs
+        the fused build on load (a resumed resident read stays
+        device-backed with fresh, correct accounting); a host-backed
+        one spills its plain ``ReadBatch``."""
+        if self._blob is not None or self._blob_parts is not None:
+            return (_rebuild_from_blob,
+                    (self._host_blob(), self._offsets, self._n_ref))
+        return (_rebuild_from_host, (self.to_read_batch(),))
+
+    # -- ReadBatch interop --------------------------------------------------
+
+    def to_read_batch(self) -> ReadBatch:
+        """Materialize as one plain ``ReadBatch``. The ragged columns
+        force the full host parse anyway, and its fixed columns are
+        byte-equal to the device-parsed ones (the identity contract) —
+        so materialization takes them from the host parse instead of
+        paying a pointless 32 B/record d2h fetch; columns sourced this
+        way are cached as fetched so ``release`` books them neither as
+        transferred nor as avoided (the host did the work, no transfer
+        was saved)."""
+        if self._rb is None:
+            with self._lock:
+                if self._rb is not None:
+                    return self._rb
+                rag = self._ragged_source()
+                if self._dev is not None:
+                    for name in FIXED_COLUMNS:
+                        if name not in self._cache:
+                            self._cache[name] = getattr(rag, name)
+                            self._consumed.pop(name, None)
+                self._rb = rag
+        return self._rb
+
+    def take(self, indices: np.ndarray) -> ReadBatch:
+        return self.to_read_batch().take(indices)
+
+    def filter(self, mask: np.ndarray) -> ReadBatch:
+        return self.to_read_batch().filter(mask)
+
+    def slice(self, start: int, stop: int) -> ReadBatch:
+        return self.to_read_batch().slice(start, stop)
+
+    # decoded views / derived (delegate to the materialized forms)
+    def name(self, i: int) -> str:
+        return self._ragged_source().name(i)
+
+    def sequence(self, i: int) -> str:
+        return self._ragged_source().sequence(i)
+
+    def cigar_string(self, i: int) -> str:
+        return self._ragged_source().cigar_string(i)
+
+    def qual_string(self, i: int) -> str:
+        return self._ragged_source().qual_string(i)
+
+    def reference_lengths(self) -> np.ndarray:
+        return self._ragged_source().reference_lengths()
+
+    def alignment_ends(self) -> np.ndarray:
+        return self._ragged_source().alignment_ends()
+
+    # -- resident device consumers ------------------------------------------
+
+    def _dev_snapshot(self) -> Optional[Dict[str, object]]:
+        """The device column dict, taken under the lock — safe to use
+        after a concurrent ``release()`` (jax arrays are immutable;
+        release only drops references), so kernel launches run
+        lock-free and never stall other lazy-column access."""
+        with self._lock:
+            return self._dev
+
+    def device_columns(self) -> Dict[str, object]:
+        """The fixed columns as device arrays in ReadBatch dtypes —
+        zero transfers (the resident form IS the device form)."""
+        dev = self._dev_snapshot()
+        if dev is None:
+            raise ValueError("host-backed batch has no device columns")
+        jnp = _jax_fns()["jnp"]
+        return {
+            name: dev[name][: self._n].astype(
+                jnp.dtype(_COL_DTYPE[name]))
+            for name in FIXED_COLUMNS
+        }
+
+    def flagstat(self) -> Dict[str, int]:
+        """flagstat over the resident flag column — no h2d re-upload,
+        d2h is the 48-byte count row."""
+        dev = self._dev_snapshot()
+        if dev is None:
+            from disq_tpu.ops.flagstat import flagstat_counts
+
+            return flagstat_counts(np.asarray(self.flag))
+        from disq_tpu.ops.flagstat import flagstat_resident
+
+        out = flagstat_resident(dev["flag"], self._n)
+        self._consume_on_device("flag", 4 * self._n)
+        return out
+
+    def sort_permutation(self) -> np.ndarray:
+        """Coordinate-sort permutation from the resident refid/pos
+        columns: keys + lexsort run on device, only the (n,) i32 order
+        crosses d2h — the u64 key vectors never move."""
+        dev = self._dev_snapshot()
+        if dev is None:
+            from disq_tpu.sort.coordinate import coordinate_keys
+
+            return np.argsort(
+                coordinate_keys(self.refid, self.pos), kind="stable")
+        fns = _jax_fns()
+        jax, jnp = fns["jax"], fns["jnp"]
+        from disq_tpu.runtime.tracing import count_transfer, device_span
+
+        n_dev = jnp.asarray(np.int32(self._n))  # staged pre-guard
+        with device_span("device.kernel", kernel="coordinate_keys",
+                         records=self._n) as fence:
+            with jax.transfer_guard("disallow"):
+                order = fns["coord_perm"](
+                    dev["refid"], dev["pos"], n_dev)
+                jax.block_until_ready(order)
+            fence.sync(order)
+        out = np.asarray(order[: self._n])
+        count_transfer("d2h", out.nbytes)
+        # the 8-byte-per-record key vector stayed on device
+        self._consume_on_device("sort_keys", 8 * self._n)
+        return out
+
+    # -- concat -------------------------------------------------------------
+
+    @classmethod
+    def concat(cls, batches: Sequence) -> "ReadBatch | ColumnarBatch":
+        """Concatenate mixed ``ReadBatch`` / ``ColumnarBatch`` shards.
+        All device-backed ⇒ the result stays device-backed (fixed
+        columns concatenated on device, host blobs rebased for ragged);
+        otherwise everything materializes to one host ``ReadBatch``.
+
+        CONSUMING: device-backed inputs are released into the result
+        (their residency moves to the concatenated columns) — keep
+        using the returned batch, not the inputs."""
+        batches = list(batches)
+        if not batches:
+            return ReadBatch.empty()
+        if len(batches) == 1:
+            return batches[0]
+        # empty shards (deadline fallbacks, ranges past end-of-data)
+        # are neutral: they must not demote an all-resident read
+        nonempty = [b for b in batches if len(b)]
+        if not nonempty:
+            return ReadBatch.empty()
+        if len(nonempty) == 1:
+            return nonempty[0]
+        batches = nonempty
+        resident = [b for b in batches
+                    if isinstance(b, ColumnarBatch) and b.device_backed]
+        if len(resident) == len(batches):
+            jnp = _jax_fns()["jnp"]
+            self = cls()
+            self._n = sum(b._n for b in batches)
+            self._n_ref = batches[0]._n_ref
+            # bucket-pad the concatenated columns like from_blob does
+            # (edge pads duplicate the last record): exact-length
+            # results would retrace every downstream jit once per
+            # distinct total record count
+            pad = _bucket_n(self._n) - self._n
+            self._dev = {
+                name: jnp.pad(
+                    jnp.concatenate(
+                        [b._dev[name][: b._n] for b in batches]),
+                    (0, pad), mode="edge")
+                for name in FIXED_COLUMNS
+            }
+            # host blobs join LAZILY (first ragged access / pickle):
+            # a flagstat-only multi-shard read never pays the
+            # O(total-decoded-bytes) memcpy or its transient 2x host
+            # RAM peak
+            parts: List[np.ndarray] = []
+            for b in batches:
+                parts.extend(b._blob_parts if b._blob_parts is not None
+                             else [b._blob])
+            self._blob_parts = parts
+            offs = np.zeros(self._n + 1, dtype=np.int64)
+            at = 1
+            pos = 0
+            for b in batches:
+                offs[at: at + b._n] = b._offsets[1:] + pos
+                at += b._n
+                pos += int(b._offsets[-1])
+            self._offsets = offs
+            self._hbm = len(self._dev) * (self._n + pad) * 4
+            from disq_tpu.runtime.tracing import track_hbm
+
+            track_hbm(self._hbm)
+            _note_build(self._hbm)
+            for b in batches:
+                # inputs live on inside the concat — release their
+                # residency without booking avoidance
+                b._release(book_avoided=False)
+            return self
+        return ReadBatch.concat([as_read_batch(b) for b in batches])
+
+    # -- release ------------------------------------------------------------
+
+    def _release(self, book_avoided: bool = True) -> None:
+        with self._lock:
+            if self._released or self._dev is None:
+                self._released = True
+                return
+            self._released = True
+            if book_avoided:
+                # only the 8 reachable fixed columns can ever be
+                # fetched — the 4 parse-only fields (block_size,
+                # lengths) are not d2h candidates and must not inflate
+                # the metric
+                avoided = sum(
+                    4 * self._n
+                    for name in FIXED_COLUMNS
+                    if name not in self._cache
+                    and name not in self._consumed)
+                total = avoided + sum(self._consumed.values())
+                from disq_tpu.runtime.tracing import counter, record_span
+
+                if total:
+                    counter("device.d2h_avoided_bytes").inc(total)
+                record_span("columnar.batch.release", 0.0,
+                            records=self._n, avoided_bytes=total)
+            self._dev = None
+            if self._hbm:
+                from disq_tpu.runtime.tracing import track_hbm
+
+                track_hbm(-self._hbm)
+                _note_build(-self._hbm)
+                self._hbm = 0
+
+    def release(self) -> None:
+        """Drop the device columns. Reachable columns never fetched,
+        plus everything consumed on device (flagstat's flag column,
+        sort keys), book into ``device.d2h_avoided_bytes`` — the d2h
+        bytes the lazy fetch skipped — and a ``columnar.batch.release``
+        span records the batch's total avoidance for
+        ``trace_report --analyze``."""
+        self._release(book_avoided=True)
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing
+        try:
+            self._release(book_avoided=True)
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+
+def _rebuild_from_blob(blob, offsets, n_ref) -> "ColumnarBatch":
+    """Unpickle target for a spilled device-backed batch (module-level
+    so pickle resolves it by name)."""
+    return ColumnarBatch.from_blob(blob, offsets, n_ref=n_ref)
+
+
+def _rebuild_from_host(batch: ReadBatch) -> "ColumnarBatch":
+    """Unpickle target for a spilled host-backed batch."""
+    return ColumnarBatch.from_host(batch)
+
+
+def as_read_batch(batch) -> ReadBatch:
+    """Whatever a source emitted (host ReadBatch or ColumnarBatch) as a
+    plain host ReadBatch."""
+    if isinstance(batch, ColumnarBatch):
+        return batch.to_read_batch()
+    return batch
+
+
+def concat_batches(batches: Sequence) -> "ReadBatch | ColumnarBatch":
+    """Shard concat for the read paths: stays device-resident when
+    every shard is, else materializes host-side. Consuming — see
+    ``ColumnarBatch.concat``: device-backed inputs are released into
+    the result."""
+    return ColumnarBatch.concat(batches)

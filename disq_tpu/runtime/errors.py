@@ -181,6 +181,13 @@ class DisqOptions:
     read_ledger: Optional[str] = None
     postmortem_dir: Optional[str] = None
     profile_hz: Optional[float] = None
+    # HBM-resident fused decode (runtime/columnar.py): sources parse
+    # each shard's decoded blob into a device-backed ColumnarBatch in
+    # the same launch chain as the device codecs — fixed columns stay
+    # resident, d2h happens lazily per column. Env equivalent:
+    # DISQ_TPU_RESIDENT_DECODE. Off (default) ⇒ plain host ReadBatch
+    # and zero device allocations (check_overhead-guarded).
+    resident_decode: bool = False
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -256,6 +263,9 @@ class DisqOptions:
         if hz <= 0:
             raise ValueError(f"profile_hz must be > 0, got {hz}")
         return replace(self, profile_hz=float(hz))
+
+    def with_resident_decode(self, enable: bool = True) -> "DisqOptions":
+        return replace(self, resident_decode=bool(enable))
 
 
 class CorruptBlockError(ValueError):

@@ -634,11 +634,18 @@ def read_ledger_for_storage(storage, path: str, n_shards: int):
     from disq_tpu.runtime.errors import ErrorPolicy
     from disq_tpu.runtime.manifest import ReadLedger
 
+    from disq_tpu.runtime.columnar import resident_decode_enabled
+
     return ReadLedger(base, params={
         "path": path,
         "shards": int(n_shards),
         "error_policy": ErrorPolicy.coerce(opts.error_policy).value,
         "shard_deadline_s": getattr(opts, "shard_deadline_s", None),
+        # resident decode changes the spilled shard *type* (ColumnarBatch
+        # spills rebuild device-side on load) — toggling it between a
+        # crashed and a resumed run must reset the ledger, not serve
+        # stale host-form spills
+        "resident_decode": bool(resident_decode_enabled(storage)),
     })
 
 

@@ -34,11 +34,23 @@ def coordinate_keys(refid: np.ndarray, pos: np.ndarray) -> np.ndarray:
 def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
     """Sort a batch into coordinate order.
 
-    The permutation comes from the device mesh when more than one device
-    is attached (psum/all_to_all exchange, ``disq_tpu.sort.sharded``);
-    ragged columns are reordered host-side by one vectorized segment
-    gather either way.
+    A device-backed ``ColumnarBatch`` (the HBM-resident fused-decode
+    currency) sorts from its resident refid/pos columns: key build +
+    lexsort run on device and only the (n,) i32 permutation crosses
+    d2h — the u64 key vectors never materialize host-side. Otherwise
+    the permutation comes from the device mesh when more than one
+    device is attached (psum/all_to_all exchange,
+    ``disq_tpu.sort.sharded``); ragged columns are reordered host-side
+    by one vectorized segment gather either way.
     """
+    from disq_tpu.runtime.columnar import ColumnarBatch
+
+    if isinstance(batch, ColumnarBatch):
+        if batch.device_backed and batch.count > 0:
+            # resident sort-key extraction: byte-identical to the host
+            # argsort (same key, both stable), zero key traffic
+            return batch.take(batch.sort_permutation())
+        batch = batch.to_read_batch()
     keys = coordinate_keys(batch.refid, batch.pos)
     order = None
     if use_mesh and batch.count > 0:

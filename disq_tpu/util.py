@@ -4,6 +4,17 @@ from __future__ import annotations
 
 import threading
 
+
+def bucket_pow2(n: int, lo: int = 64) -> int:
+    """Power-of-two compile-shape bucket (floor ``lo``): the ONE
+    padding policy shared by the SIMD inflate chunk shapes, the device
+    parse starts, and ColumnarBatch concat — so their jit caches bucket
+    identically and a policy change cannot silently diverge them."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
 _HOST_POOL = None
 _HOST_POOL_LOCK = threading.Lock()
 

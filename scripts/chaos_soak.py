@@ -247,6 +247,53 @@ def run_iteration(path, data, n_records, baseline, it_seed: int,
     return ""
 
 
+def resident_leg(path, baseline) -> str:
+    """--resident leg: the HBM-resident fused decode path
+    (``runtime/columnar.py``) read through a transient-fault schedule
+    must produce a device-backed batch whose every column, after d2h,
+    is byte-identical to the fault-free host-path baseline — the
+    identity contract of ROADMAP item 1 under chaos."""
+    from dataclasses import fields as dc_fields
+
+    import numpy as np
+
+    from disq_tpu import DisqOptions, ReadsStorage
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        FaultSpec,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+    from disq_tpu.runtime.columnar import ColumnarBatch
+
+    faults = [
+        FaultSpec(kind="transient", probability=0.08),
+        FaultSpec(kind="truncate", probability=0.04, truncate_bytes=80),
+    ]
+    fsw = FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(), faults, seed=4242)
+    register_filesystem("fault", fsw)
+    opts = DisqOptions(max_retries=8, retry_backoff_s=0.0,
+                       executor_workers=2, resident_decode=True)
+    try:
+        ds = (ReadsStorage.make_default().split_size(SPLIT)
+              .options(opts).read("fault://" + path))
+    except Exception as e:  # noqa: BLE001 — any escape is a failure
+        return f"resident: {type(e).__name__}: {e}"
+    if not isinstance(ds.reads, ColumnarBatch) or not ds.reads.device_backed:
+        return "resident: read did not produce a device-backed batch"
+    if ds.count() != baseline.count():
+        return (f"resident: {ds.count()} records != baseline "
+                f"{baseline.count()}")
+    got = ds.reads.to_read_batch()
+    for f in dc_fields(got):
+        if not np.array_equal(getattr(got, f.name),
+                              getattr(baseline.reads, f.name)):
+            return f"resident: column {f.name} differs from host path"
+    ds.reads.release()
+    return ""
+
+
 def breaker_leg(path, baseline) -> str:
     """Deterministic circuit-breaker scenario: a total fault storm must
     trip the breaker within its window, rejected calls must fail fast
@@ -570,6 +617,12 @@ def main(argv=None) -> int:
                          "its window, open-state reads must fail fast, "
                          "and a half-open probe must reclose it with "
                          "byte-identical output")
+    ap.add_argument("--resident", action="store_true",
+                    help="run the HBM-resident fused-decode leg: a "
+                         "resident_decode read through a transient-"
+                         "fault schedule must yield a device-backed "
+                         "batch byte-identical (after d2h) to the "
+                         "fault-free host path")
     ap.add_argument("--kill", action="store_true",
                     help="run the crash-resume leg: SIGKILL a writer "
                          "subprocess mid-run, resume from its "
@@ -616,6 +669,11 @@ def main(argv=None) -> int:
         if args.breaker:
             err = breaker_leg(path, baseline)
             print(f"[breaker] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.resident:
+            err = resident_leg(path, baseline)
+            print(f"[resident] {'ok' if not err else 'FAIL: ' + err}")
             if err:
                 failures.append((args.seed, err))
         if args.kill:

@@ -55,6 +55,9 @@ ALLOWED_PREFIXES = {
     # runtime/profiler.py): event-ring + bundle bookkeeping and the
     # sampling profiler's per-role sample counters.
     "flightrec", "profile",
+    # HBM-resident fused decode (runtime/columnar.py): ColumnarBatch
+    # build/fetch/release spans and the resident-bytes gauge.
+    "columnar",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

@@ -113,6 +113,19 @@ def main() -> int:
             "device decode service instantiated with no flag set — the "
             "disabled path must spawn zero dispatcher threads")
 
+    # -- 1c. resident decode: disabled ⇒ no ColumnarBatch device builds ------
+    from disq_tpu.runtime import columnar
+
+    if columnar.resident_decode_enabled(_Storage()):
+        errors.append(
+            "DISQ_TPU_RESIDENT_DECODE leaked into the guard's env — "
+            "the default path must decode to host ReadBatch objects")
+    if columnar.device_batches_built() != 0:
+        errors.append(
+            f"{columnar.device_batches_built()} device-backed "
+            "ColumnarBatch builds on the disabled path — resident "
+            "decode off must allocate nothing on device")
+
     # -- 2. timing: per-shard inline-executor overhead -----------------------
     sink = []
 

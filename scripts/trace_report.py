@@ -90,6 +90,10 @@ CATEGORIES = (
     # oldest-lane wait of each flushed chunk — lanes sitting batched
     # before their kernel launched.
     ("service_wait", "w", ("device.service.wait",)),
+    # HBM-resident fused decode (runtime/columnar.py): ColumnarBatch
+    # build (upload-or-in-place parse chain), lazy per-column fetches,
+    # and release events carrying the batch's d2h-avoided bytes.
+    ("columnar", "C", ("columnar.",)),
     # Hedged duplicate fetches (runtime/resilience.py): the duplicate's
     # own execution (hedge.fetch) and the loser's burned time
     # (hedge.waste) both paint H — a hedge racing its primary is
@@ -338,7 +342,8 @@ STALL_CATEGORIES = {"emit_stall", "retry", "quarantine", "watchdog"}
 # it only wins instants where nothing else is making progress — and
 # hedge-wasted time ranks last among work: it is burned concurrency,
 # attributed to its own bucket so the --analyze verdict can name it.
-WORK_PRIORITY = ("device", "transfer", "decode", "encode", "deflate",
+WORK_PRIORITY = ("device", "transfer", "columnar", "decode", "encode",
+                 "deflate",
                  "stage", "fetch", "hedge", "hedge_wasted",
                  # service queue wait ranks last: it only wins instants
                  # where nothing is making progress — lanes parked in
@@ -372,6 +377,14 @@ ADVICE = {
                     "batched while the device idles — lower "
                     "DISQ_TPU_SERVICE_FLUSH_MS, or raise "
                     "executor_workers so more shards feed the batcher",
+    "columnar": "resident-decode build/fetch dominates: columns are "
+                "being materialized host-side after all — check which "
+                "consumer forces the fetches, or widen shards so one "
+                "parse launch covers more records",
+    "d2h_avoided": "the fused resident path is paying off: these "
+                   "bytes stayed in HBM instead of crossing d2h — "
+                   "keep consumers on the resident columns "
+                   "(flagstat/sort/depth) to grow this number",
 }
 
 
@@ -537,6 +550,24 @@ def analyze(spans, run, runs, dropped: int = 0) -> str:
             line += token
         if line.strip():
             out.append(line.rstrip())
+        out.append("")
+
+    # d2h_avoided: a bytes bucket, not a wall-clock one — summed from
+    # the columnar.batch.release spans' avoided_bytes labels (each
+    # batch's device-resident columns that never crossed d2h).
+    avoided = 0
+    for s in spans:
+        if s["name"] == "columnar.batch.release":
+            try:
+                avoided += int((s.get("labels") or {}).get(
+                    "avoided_bytes", 0))
+            except (TypeError, ValueError):
+                pass
+    if avoided:
+        out.append(
+            f"d2h_avoided: {avoided / 1e6:.2f} MB stayed "
+            "device-resident (never fetched)")
+        out.append(f"  ({ADVICE['d2h_avoided']})")
         out.append("")
 
     top = order[0]
