@@ -693,6 +693,70 @@ def resident_decode_config(path: str) -> dict:
     return {"10_resident_decode": out}
 
 
+def device_write_config(path: str, tmp: str) -> dict:
+    """Config 11: the symmetric device write path — sort + single-file
+    BAM write + BAI through resident encode + device SIMD deflate
+    (``DisqOptions.device_deflate`` + ``resident_decode``; the decode
+    service coalesces write-shard blocks) against the host zlib path,
+    at writer widths 1 and 4 — real chip only.
+
+    Each row carries h2d/d2h byte columns from ``device.*`` registry
+    deltas, so "compressed-only d2h" is measured, not asserted: the
+    device rows' d2h must sit near the compressed size, far below the
+    raw payload bytes the split design would have moved.  Every
+    produced file is re-read through the framework reader inside the
+    timed body (count asserted), so a byte-invalid stream can never
+    post a throughput number."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from disq_tpu import ReadsStorage
+    from disq_tpu.api import BaiWriteOption
+    from disq_tpu.runtime import device_service
+    from disq_tpu.runtime.tracing import REGISTRY
+
+    h2d = REGISTRY.counter("device.bytes_to_device")
+    d2h = REGISTRY.counter("device.bytes_to_host")
+    rows: dict = {}
+    prev = os.environ.get("DISQ_TPU_DEVICE_SERVICE")
+    os.environ["DISQ_TPU_DEVICE_SERVICE"] = "1"
+    try:
+        for w in (1, 4):
+            for mode in ("host", "device"):
+                st = (ReadsStorage.make_default().num_shards(16)
+                      .writer_workers(w))
+                if mode == "device":
+                    st = st.resident_decode().device_deflate()
+                ds = st.read(path)
+                out = os.path.join(tmp, f"bench-devw-{mode}-w{w}.bam")
+
+                def run(st=st, ds=ds, out=out):
+                    st.write(ds, out, BaiWriteOption.ENABLE, sort=True)
+                    assert (ReadsStorage.make_default()
+                            .read(out).count() == N_RECORDS)
+
+                run()  # warm (compiles, page cache)
+                b0 = (h2d.total(), d2h.total())
+                med, times = _timed(run, 3)
+                rows[f"{mode}_workers_{w}"] = {
+                    "records_per_sec": round(N_RECORDS / med, 1),
+                    "spread": _spread(times),
+                    "h2d_bytes": int((h2d.total() - b0[0]) / len(times)),
+                    "d2h_bytes": int((d2h.total() - b0[1]) / len(times)),
+                }
+            rows[f"device_vs_host_workers_{w}"] = round(
+                rows[f"device_workers_{w}"]["records_per_sec"]
+                / rows[f"host_workers_{w}"]["records_per_sec"], 3)
+    finally:
+        if prev is None:
+            os.environ.pop("DISQ_TPU_DEVICE_SERVICE", None)
+        else:
+            os.environ["DISQ_TPU_DEVICE_SERVICE"] = prev
+        device_service.shutdown_service()
+    return {"11_device_write": rows}
+
+
 def main() -> None:
     # DISQ_TPU_POSTMORTEM_DIR arms the flight recorder for the whole
     # bench: any abort writes a postmortem bundle there, and
@@ -759,6 +823,7 @@ def main() -> None:
     configs.update(device_inflate_config(path))
     configs.update(device_service_config(path))
     configs.update(resident_decode_config(path))
+    configs.update(device_write_config(path, tmp))
 
     # Telemetry snapshot accumulated across every config above
     # (runtime/tracing.py): phase totals + p50/p99, labeled counters

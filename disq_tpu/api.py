@@ -202,11 +202,21 @@ class ReadsDataset:
 
         return introspect_address()
 
-    def coordinate_sorted(self) -> "ReadsDataset":
+    def coordinate_sorted(self, keep_resident: bool = False) -> "ReadsDataset":
+        """Coordinate-sort the dataset.  ``keep_resident`` keeps a
+        device-backed ``ColumnarBatch`` device-backed through the sort
+        (fixed columns permuted on device, host records never
+        materialized) so the device write path's resident encode →
+        deflate chain can consume it directly — armed automatically by
+        ``ReadsStorage.write(..., sort=True)`` when
+        ``DisqOptions.device_deflate`` is on."""
         from disq_tpu.sort.coordinate import coordinate_sort_batch
 
         header = self.header.with_sort_order("coordinate")
-        return ReadsDataset(header=header, reads=coordinate_sort_batch(self.reads))
+        return ReadsDataset(
+            header=header,
+            reads=coordinate_sort_batch(
+                self.reads, keep_resident=keep_resident))
 
     def device_columns(self, sharding=None) -> dict:
         """The fixed record columns as device-resident jax Arrays (one
@@ -496,6 +506,21 @@ class ReadsStorage:
         self._options = self._options.with_resident_decode(enable)
         return self
 
+    def device_deflate(self, enable: bool = True) -> "ReadsStorage":
+        """Arm the symmetric device write path (``ops/deflate.py`` +
+        ``runtime/device_write.py``): every BGZF deflate this storage's
+        sinks run routes through the 128-lane SIMD entropy coder
+        (coalesced across in-flight write shards when the device
+        service is up), and a ``write(..., sort=True)`` of a resident
+        ``ColumnarBatch`` keeps the sorted records device-side through
+        encode → deflate — only compressed blocks (plus their sizes,
+        which the voffset/BAI arithmetic needs) cross d2h.  Output is
+        byte-VALID BGZF readable by every reader, but NOT
+        byte-identical to the canonical host zlib pin.  Env
+        equivalent: ``DISQ_TPU_DEVICE_DEFLATE``."""
+        self._options = self._options.with_device_deflate(enable)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -543,7 +568,10 @@ class ReadsStorage:
         from disq_tpu.runtime import flightrec
 
         if sort:
-            dataset = dataset.coordinate_sorted()
+            from disq_tpu.bgzf.codec import device_deflate_enabled
+
+            dataset = dataset.coordinate_sorted(
+                keep_resident=device_deflate_enabled(self))
         fmt_opt = _opt(options, ReadsFormatWriteOption, None)
         fmt = sam_format_from_write_options(path, fmt_opt)
         cardinality = _opt(options, FileCardinalityWriteOption, _infer_cardinality(path))
@@ -669,6 +697,13 @@ class VariantsStorage:
         option sets stay interchangeable across storages (the variant
         columnar currency is ROADMAP item 4's port)."""
         self._options = self._options.with_resident_decode(enable)
+        return self
+
+    def device_deflate(self, enable: bool = True) -> "VariantsStorage":
+        """See ``ReadsStorage.device_deflate``: routes every BGZF
+        deflate of this storage's sinks (VCF_BGZ parts and headers,
+        BCF's whole-stream blocks) through the device SIMD encoder."""
+        self._options = self._options.with_device_deflate(enable)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

@@ -43,7 +43,11 @@ from disq_tpu.bam.codec import encode_records, encode_records_with_offsets
 from disq_tpu.bam.columnar import ReadBatch
 from disq_tpu.bam.header import SamHeader
 from disq_tpu.bgzf.block import BGZF_EOF_MARKER, BGZF_MAX_PAYLOAD
-from disq_tpu.bgzf.codec import compress_to_bgzf, deflate_blob
+from disq_tpu.bgzf.codec import (
+    compress_to_bgzf,
+    deflate_blob,
+    device_deflate_enabled,
+)
 from disq_tpu.fsw.filesystem import FileSystemWrapper, resolve_path
 from disq_tpu.index.bai import BaiIndex, build_bai, merge_bai_fragments
 from disq_tpu.index.sbi import SbiIndex
@@ -87,27 +91,78 @@ def _opt_enabled(options: Sequence[WriteOption], cls, default: bool) -> bool:
     return default
 
 
-def bgzf_compress_with_voffsets(
-    blob: bytes, record_offsets: np.ndarray
-) -> Tuple[bytes, np.ndarray, np.ndarray]:
-    """Deflate ``blob`` into canonical BGZF (no terminator) and return
-    (compressed bytes, start voffsets, end voffsets) for the records whose
-    uncompressed offsets are ``record_offsets`` ((N+1,): starts + end)."""
-    comp, csizes = deflate_blob(blob)
+def voffsets_from_csizes(
+    csizes: np.ndarray, record_offsets: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(start voffsets, end voffsets) for records at uncompressed
+    offsets ``record_offsets`` ((N+1,)) inside a BGZF stream whose
+    per-block compressed sizes are ``csizes`` — pure array arithmetic,
+    shared by the host deflate and the device write path (whose csizes
+    are the only thing that crosses d2h)."""
     block_comp_start = np.zeros(len(csizes) + 1, dtype=np.int64)
     np.cumsum(csizes, out=block_comp_start[1:])
     offs = record_offsets.astype(np.int64)
     block_idx = offs // BGZF_MAX_PAYLOAD
     within = offs % BGZF_MAX_PAYLOAD
     voffs = (block_comp_start[block_idx].astype(np.uint64) << np.uint64(16)) | within.astype(np.uint64)
-    return comp, voffs[:-1], voffs[1:]
+    return voffs[:-1], voffs[1:]
+
+
+def bgzf_compress_with_voffsets(
+    blob: bytes, record_offsets: np.ndarray, device: Optional[bool] = None
+) -> Tuple[bytes, np.ndarray, np.ndarray]:
+    """Deflate ``blob`` into canonical BGZF (no terminator) and return
+    (compressed bytes, start voffsets, end voffsets) for the records whose
+    uncompressed offsets are ``record_offsets`` ((N+1,): starts + end).
+    ``device`` routes the deflate like ``bgzf.codec.deflate_blob``."""
+    comp, csizes = deflate_blob(blob, device=device)
+    voffs, end_voffs = voffsets_from_csizes(csizes, record_offsets)
+    return comp, voffs, end_voffs
+
+
+class _LazySlice:
+    """Deferred shard slice for the resident write path: the SBI/BAI
+    fragment builders touch host columns only when an index was
+    requested, so a plain (no-index) resident write never materializes
+    host records at all."""
+
+    __slots__ = ("_batch", "_lo", "_hi", "_part")
+
+    def __init__(self, batch, lo: int, hi: int) -> None:
+        self._batch = batch
+        self._lo, self._hi = lo, hi
+        self._part = None
+
+    @property
+    def count(self) -> int:
+        return self._hi - self._lo
+
+    def _mat(self):
+        if self._part is None:
+            self._part = self._batch.slice(self._lo, self._hi)
+        return self._part
+
+    def alignment_ends(self):
+        return self._mat().alignment_ends()
+
+    def __getattr__(self, name: str):
+        return getattr(self._mat(), name)
 
 
 class BamSink:
-    """Single-file BAM write (``FileCardinalityWriteOption.SINGLE``)."""
+    """Single-file BAM write (``FileCardinalityWriteOption.SINGLE``).
+
+    With ``DisqOptions.device_deflate`` armed, the per-shard deflate
+    routes through the device SIMD encoder (service-coalesced across
+    in-flight write shards), and a sorted device-backed
+    ``ColumnarBatch`` additionally encodes its records ON DEVICE
+    (``runtime/device_write.py``): sort permutation → record-byte
+    gather → entropy coder run HBM-resident, and only compressed
+    blocks (plus csizes for the voffset/BAI arithmetic) cross d2h."""
 
     def __init__(self, storage=None):
         self._storage = storage
+        self._device = False
 
     def _num_shards(self) -> int:
         return resolve_num_shards(self._storage)
@@ -135,6 +190,12 @@ class BamSink:
             (o for o in options if isinstance(o, StageManifestWriteOption)), None
         )
         n_shards, bounds = shard_bounds(self._storage, batch.count)
+        self._device = device_deflate_enabled(self._storage)
+        resident = None
+        if self._device:
+            from disq_tpu.runtime.device_write import resident_encoder_for
+
+            resident = resident_encoder_for(self._storage, batch)
         if manifest_opt is not None:
             from disq_tpu.runtime import StageManifest
 
@@ -147,13 +208,18 @@ class BamSink:
                     "n_shards": int(n_shards),
                     "bai": write_bai,
                     "sbi": write_sbi,
+                    # the device coder's bytes are valid but not
+                    # byte-identical to the zlib pin: flipping the knob
+                    # between a crash and a resume must reset staging,
+                    # not concatenate mixed-provenance parts
+                    "device_deflate": bool(self._device),
                 },
             )
         fs.mkdirs(temp_dir)
         try:
             self._write_parts_and_merge(
                 fs, header, batch, path, temp_dir, n_shards, bounds,
-                write_bai, write_sbi, manifest,
+                write_bai, write_sbi, manifest, resident,
             )
         except BaseException:
             # Idempotent write protocol (SURVEY.md §5): the merge is the
@@ -174,19 +240,34 @@ class BamSink:
 
     # -- pipeline stage bodies (encode → deflate → stage) -------------------
 
-    def _encode_shard(self, batch, bounds, k):
-        """Stage 1 (CPU): slice shard ``k`` and encode its records."""
-        part = batch.slice(int(bounds[k]), int(bounds[k + 1]))
+    def _encode_shard(self, batch, bounds, k, resident=None):
+        """Stage 1: slice shard ``k`` and encode its records — on host
+        (CPU record encode), or as a device record-byte gather when the
+        resident write path is armed (the encoded blob then stays in
+        HBM for the deflate stage; host columns materialize only if an
+        index build asks for them)."""
+        lo, hi = int(bounds[k]), int(bounds[k + 1])
+        if resident is not None:
+            enc = resident.encode_shard(lo, hi)
+            return _LazySlice(batch, lo, hi), enc, enc.record_offsets
+        part = batch.slice(lo, hi)
         blob, rec_offs = encode_records_with_offsets(part)
         return part, blob, rec_offs
 
     def _deflate_shard(self, header, write_bai, write_sbi, payload):
-        """Stage 2 (native-threaded CPU): canonical BGZF deflate,
-        vectorized voffset arithmetic, and index-fragment build."""
+        """Stage 2 (native-threaded CPU, or the device SIMD coder):
+        BGZF deflate, vectorized voffset arithmetic, and index-fragment
+        build.  A resident-encoded shard deflates straight from its
+        device blob — only compressed blocks and csizes come back."""
         from disq_tpu.runtime import check_voffsets, debug_enabled
 
         part, blob, rec_offs = payload
-        comp, voffs, end_voffs = bgzf_compress_with_voffsets(blob, rec_offs)
+        if hasattr(blob, "deflate"):  # runtime/device_write.EncodedShard
+            comp, csizes = blob.deflate()
+            voffs, end_voffs = voffsets_from_csizes(csizes, rec_offs)
+        else:
+            comp, voffs, end_voffs = bgzf_compress_with_voffsets(
+                blob, rec_offs, device=self._device)
         if debug_enabled():
             check_voffsets(voffs)
         sbi_frag = bai_frag = None
@@ -227,7 +308,7 @@ class BamSink:
 
     def _write_one_part(
         self, fs, header, batch, temp_dir, bounds, write_bai, write_sbi, k,
-        frag_cache=None,
+        frag_cache=None, resident=None,
     ) -> dict:
         """Whole-shard unit (encode + deflate + stage in one call) —
         the sequential manifest path's work function, and the
@@ -235,7 +316,7 @@ class BamSink:
         from disq_tpu.runtime.tracing import span
 
         with span("bam.write.encode", shard=k):
-            payload = self._encode_shard(batch, bounds, k)
+            payload = self._encode_shard(batch, bounds, k, resident)
         with span("bam.write.deflate", shard=k):
             payload = self._deflate_shard(header, write_bai, write_sbi,
                                           payload)
@@ -243,7 +324,8 @@ class BamSink:
             return self._stage_shard(fs, temp_dir, k, frag_cache, payload)
 
     def _make_write_task(self, fs, header, batch, temp_dir, bounds,
-                         write_bai, write_sbi, k, frag_cache):
+                         write_bai, write_sbi, k, frag_cache,
+                         resident=None):
         from disq_tpu.runtime.executor import (
             WriteShardTask,
             write_retrier_for_storage,
@@ -254,7 +336,8 @@ class BamSink:
             shard_id=k,
             encode=wrap_span(
                 "bam.write.encode",
-                lambda: self._encode_shard(batch, bounds, k), shard=k),
+                lambda: self._encode_shard(batch, bounds, k, resident),
+                shard=k),
             deflate=wrap_span(
                 "bam.write.deflate",
                 lambda p: self._deflate_shard(
@@ -271,7 +354,7 @@ class BamSink:
 
     def _write_parts_and_merge(
         self, fs, header, batch, path, temp_dir, n_shards, bounds,
-        write_bai, write_sbi, manifest=None,
+        write_bai, write_sbi, manifest=None, resident=None,
     ) -> None:
         from disq_tpu.runtime import trace_phase
         from disq_tpu.runtime.executor import (
@@ -286,25 +369,40 @@ class BamSink:
         # resumed shards reload from disk below.
         frag_cache = None if manifest is not None else {}
 
-        with trace_phase("bam.write.parts"):
-            if manifest is not None and pipeline.workers == 1:
-                # Historical sequential-checkpoint path: run_stage owns
-                # skip/retry/RuntimeError semantics shard by shard.
-                infos = manifest.run_stage(
-                    "bam.parts", n_shards,
-                    lambda k: self._write_one_part(
-                        fs, header, batch, temp_dir, bounds,
-                        write_bai, write_sbi, k,
-                    ),
-                )
-            else:
-                infos = run_write_stage(
-                    pipeline, n_shards,
-                    lambda k: self._make_write_task(
-                        fs, header, batch, temp_dir, bounds,
-                        write_bai, write_sbi, k, frag_cache),
-                    manifest=manifest, stage_name="bam.parts",
-                )
+        # the historical 9-arg call survives when the resident path is
+        # off (tests wrap _write_one_part with that exact signature);
+        # the device write path extends it only when armed
+        if resident is None:
+            def one_part(k):
+                return self._write_one_part(
+                    fs, header, batch, temp_dir, bounds,
+                    write_bai, write_sbi, k)
+        else:
+            def one_part(k):
+                return self._write_one_part(
+                    fs, header, batch, temp_dir, bounds,
+                    write_bai, write_sbi, k, resident=resident)
+        try:
+            with trace_phase("bam.write.parts"):
+                if manifest is not None and pipeline.workers == 1:
+                    # Historical sequential-checkpoint path: run_stage
+                    # owns skip/retry/RuntimeError semantics per shard.
+                    infos = manifest.run_stage(
+                        "bam.parts", n_shards, one_part)
+                else:
+                    infos = run_write_stage(
+                        pipeline, n_shards,
+                        lambda k: self._make_write_task(
+                            fs, header, batch, temp_dir, bounds,
+                            write_bai, write_sbi, k, frag_cache,
+                            resident),
+                        manifest=manifest, stage_name="bam.parts",
+                    )
+        finally:
+            if resident is not None:
+                # the shared record-blob upload dies with the parts
+                # stage; the merge below is host-side concat only
+                resident.release()
         part_paths = [i["part"] for i in infos]
         part_lens = [i["len"] for i in infos]
 
@@ -326,7 +424,9 @@ class BamSink:
         # retried write/concat safe).
         driver = write_retrier_for_storage(self._storage, path)
         with trace_phase("bam.write.merge"):
-            header_comp = compress_to_bgzf(header.to_bam_bytes(), with_terminator=False)
+            header_comp = compress_to_bgzf(
+                header.to_bam_bytes(), with_terminator=False,
+                device=self._device)
             header_path = os.path.join(temp_dir, "_header")
             driver.call(fs.write_all, header_path, header_comp,
                         what="bam.merge")
@@ -372,6 +472,7 @@ class BamSinkMultiple:
         n_shards, bounds = shard_bounds(self._storage, batch.count)
         fs.mkdirs(path)
         header_bytes = header.to_bam_bytes()
+        device = device_deflate_enabled(self._storage)
 
         def make_task(k):
             def encode():
@@ -386,8 +487,10 @@ class BamSinkMultiple:
             return WriteShardTask(
                 shard_id=k,
                 encode=wrap_span("bam.write.encode", encode, shard=k),
-                deflate=wrap_span("bam.write.deflate", compress_to_bgzf,
-                                  shard=k),
+                deflate=wrap_span(
+                    "bam.write.deflate",
+                    lambda data: compress_to_bgzf(data, device=device),
+                    shard=k),
                 stage=wrap_span("bam.write.stage", stage, shard=k),
                 retrier=write_retrier_for_storage(self._storage, path),
                 what="bam.part",

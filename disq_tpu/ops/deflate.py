@@ -50,12 +50,19 @@ from __future__ import annotations
 
 import functools
 import struct
+import threading
 import zlib
-from typing import List, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from disq_tpu.bgzf.block import BGZF_MAX_PAYLOAD as BLOCK_PAYLOAD
+from disq_tpu.runtime.tracing import (
+    count_transfer as _count_transfer,
+    counter as _counter,
+    device_span as _device_span,
+    span as _span,
+)
 
 # bam/sink.py computes write-side virtual offsets as offs // the shared
 # BGZF_MAX_PAYLOAD (0xFF00), so the device path MUST chunk payload at
@@ -226,52 +233,225 @@ def build_dynamic_header(
 
 
 # ---------------------------------------------------------------------------
-# device: batched body encode
+# device: 128-lane batched body encode (the inflate_simd dispatch layout)
+#
+# One launch encodes <= 128 BGZF block payloads, one per lane, packed
+# into the SAME (cw, 128) LE-word column layout the SIMD inflate/rANS
+# kernels use — so the launches share ``ops/inflate_simd``'s pooled
+# staging arenas (``ARENAS`` keyed ("deflate", cw)), its ``_pack_chunk``
+# packer, and its adaptive ``dispatch_window``.  The per-call Huffman
+# code/length LUTs are uploaded once per table (``DeflateTable.luts``)
+# and stay device-resident across every chunk launch of that call.
+
+LANES = 128  # mirrors ops/inflate_simd.LANES (not imported: this module
+#              must import without jax for the disabled-path guard)
+
+#: Per-call observability (VERDICT r4 weak #6): blocks encoded, blocks
+#: the entropy coder expanded that host zlib re-deflated
+#: (``host_fallback``), and of those the ones zlib also expanded and
+#: stored (BTYPE=00, ``stored_fallback``).
+last_stats = {"blocks": 0, "stored_fallback": 0, "host_fallback": 0}
+
+#: Process-lifetime device-work accounting for the zero-overhead guard
+#: (``scripts/check_overhead.py``): with device deflate off, every
+#: entry must stay 0 — no kernel launches, no LUT uploads, no arenas.
+device_stats = {"launches": 0, "lut_uploads": 0, "device_blocks": 0}
 
 
-@functools.partial(__import__("jax").jit, static_argnames=("out_bytes",))
-def _encode_bodies(
-    payload, nbytes, code_lut, len_lut, base_bits, out_bytes: int
-):
-    """All blocks at once: (B, P) u8 payload → (B, out_bytes) u8 body
-    bytes (bits [base_bits, base_bits+body_bits) populated; the header
-    region below base_bits is all-zero for the host to OR in) plus the
-    per-block end bit offset."""
+@functools.lru_cache(maxsize=16)
+def _compiled(cw: int, out_bytes: int):
+    """The batched lane encoder for one (comp words, output bound)
+    geometry: (cw, 128) u32 payload columns + (1, 128) byte counts →
+    (128, out_bytes) u8 lanes-major body bytes (bits [base_bits,
+    base_bits + body_bits) populated; the header region below
+    ``base_bits`` is all-zero for the host to OR in) plus the (1, 128)
+    per-lane end bit offsets.  ``base_bits`` stays traced so one
+    compile serves every header of the same geometry."""
     import jax
     import jax.numpy as jnp
 
-    B, P = payload.shape
-    sym = payload.astype(jnp.int32)
-    valid = jnp.arange(P)[None, :] < nbytes[:, None]
-    lens = jnp.where(valid, len_lut[sym], 0)
-    # Exclusive cumsum of code lengths → each code's start bit.
-    starts = base_bits + jnp.cumsum(lens, axis=1) - lens
-    codes = jnp.where(valid, code_lut[sym], 0).astype(jnp.uint32)
-    shift = (starts & 7).astype(jnp.uint32)
-    v = codes << shift                      # ≤ 15+7 = 22 bits
-    # Bit starts are monotonic within a block and blocks are laid out
-    # consecutively, so the flattened target byte indices are SORTED —
-    # a sorted segment-sum, which XLA lowers far better than a general
-    # scatter. Codes occupy disjoint bit ranges, so add == bitwise-or.
-    row_base = jnp.arange(B)[:, None] * out_bytes
-    out_flat = jnp.zeros(B * out_bytes, dtype=jnp.int32)
-    for k, part in enumerate(
-        (v & 0xFF, (v >> 8) & 0xFF, (v >> 16) & 0xFF)
-    ):
-        ids = (row_base + (starts >> 3) + k).reshape(-1)
-        out_flat = out_flat + jax.ops.segment_sum(
-            jnp.where(valid, part, 0).astype(jnp.int32).reshape(-1),
-            ids, num_segments=B * out_bytes, indices_are_sorted=True,
-        )
-    end_bits = base_bits + jnp.sum(lens, axis=1)
-    return out_flat.reshape(B, out_bytes).astype(jnp.uint8), end_bits
+    def encode(comp, clen, code_lut, len_lut, base_bits):
+        P = cw * 4
+        # LE word columns → lanes-major byte symbols (128, P)
+        parts = [((comp >> jnp.uint32(8 * k)) & jnp.uint32(0xFF))
+                 for k in range(4)]
+        sym = jnp.transpose(
+            jnp.stack(parts, axis=1).reshape(P, LANES)).astype(jnp.int32)
+        n = clen.reshape(LANES)
+        valid = jnp.arange(P)[None, :] < n[:, None]
+        lens = jnp.where(valid, len_lut[sym], 0)
+        # Exclusive cumsum of code lengths → each code's start bit.
+        starts = base_bits + jnp.cumsum(lens, axis=1) - lens
+        codes = jnp.where(valid, code_lut[sym], 0).astype(jnp.uint32)
+        shift = (starts & 7).astype(jnp.uint32)
+        v = codes << shift                      # ≤ 15+7 = 22 bits
+        # Bit starts are monotonic within a lane and lanes are laid out
+        # consecutively, so the flattened target byte indices are
+        # SORTED — a sorted segment-sum, which XLA lowers far better
+        # than a general scatter. Codes occupy disjoint bit ranges, so
+        # add == bitwise-or.
+        row_base = jnp.arange(LANES)[:, None] * out_bytes
+        out_flat = jnp.zeros(LANES * out_bytes, dtype=jnp.int32)
+        for k, part in enumerate(
+            (v & 0xFF, (v >> 8) & 0xFF, (v >> 16) & 0xFF)
+        ):
+            ids = (row_base + (starts >> 3) + k).reshape(-1)
+            out_flat = out_flat + jax.ops.segment_sum(
+                jnp.where(valid, part, 0).astype(jnp.int32).reshape(-1),
+                ids, num_segments=LANES * out_bytes,
+                indices_are_sorted=True,
+            )
+        end_bits = (base_bits + jnp.sum(lens, axis=1)).astype(
+            jnp.int32).reshape(1, LANES)
+        return out_flat.reshape(LANES, out_bytes).astype(jnp.uint8), end_bits
+
+    # clen (1,128) i32 is donated to back the same-shaped end_bits
+    # output (the body buffer has no aliasable input); CPU jax has no
+    # donation and would warn on every launch, so gate on backend.
+    donate = (1,) if jax.default_backend() == "tpu" else ()
+    return jax.jit(encode, donate_argnums=donate)
+
+
+def bucket_for(payloads: Sequence) -> int:
+    """The arena/compile word-column bucket for one lane chunk — the
+    inflate_simd sizing policy applied to uncompressed payloads."""
+    from disq_tpu.util import bucket_pow2
+
+    return bucket_pow2(max(len(p) for p in payloads) // 4 + 2)
+
+
+class DeflateTable:
+    """One shared dynamic-Huffman literal table: the host O(alphabet)
+    work (package-merge + RFC 1951 §3.2.7 header) done once, plus the
+    2 KB code/length LUT pair uploaded to the device ONCE and reused by
+    every chunk launch encoding under this table."""
+
+    __slots__ = ("lit_lens", "header_bits", "header_bytes", "eob_rev",
+                 "eob_len", "max_code", "out_bytes", "_rev", "_luts",
+                 "_lock")
+
+    def __init__(self, freq: np.ndarray, eob_count: int) -> None:
+        with _span("device.deflate.table"):
+            lit_freq = np.concatenate(
+                [np.asarray(freq, np.int64), [max(1, int(eob_count))]])
+            self.lit_lens = limited_huffman_lengths(lit_freq, _MAX_BITS)
+            # A non-empty payload always yields >= 2 present symbols (a
+            # literal plus EOB), which zlib's dynamic decoder requires.
+            assert np.count_nonzero(self.lit_lens) >= 2
+            lit_codes = canonical_codes(self.lit_lens)
+            dist_lens = np.array([1], np.int32)  # single 1-bit dist code
+            acc, nbits = build_dynamic_header(self.lit_lens, dist_lens)
+            # 4096-bit allowance covers the RFC-worst dynamic header
+            # (~3700 bits: 258 CL-coded lengths at <=7 bits + extras).
+            assert nbits < 4096
+            self.header_bits = nbits
+            self.header_bytes = acc.to_bytes((nbits + 7) // 8, "little")
+            self._rev = _reverse_bits(lit_codes, self.lit_lens)
+            self.eob_rev = int(self._rev[_EOB])
+            self.eob_len = int(self.lit_lens[_EOB])
+            # Output bound from the ACTUAL max literal code length, with
+            # the static header allowance; rounded to 8 KiB buckets so
+            # out_bytes (a static jit arg) hits a handful of compiled
+            # variants, not one per payload histogram.
+            self.max_code = int(self.lit_lens[:256].max())
+            ob = (4096 + BLOCK_PAYLOAD * self.max_code + _MAX_BITS) // 8 + 2
+            self.out_bytes = (ob + 8191) // 8192 * 8192
+            self._luts: Optional[Tuple[Any, Any]] = None
+            self._lock = threading.Lock()
+
+    def luts(self) -> Tuple[Any, Any]:
+        """The (code, length) LUTs as device-resident arrays — uploaded
+        once per table, shared by every chunk launch."""
+        with self._lock:
+            if self._luts is None:
+                import jax
+                import jax.numpy as jnp
+
+                code = jnp.asarray(self._rev[:256].astype(np.uint32))
+                length = jnp.asarray(self.lit_lens[:256].astype(np.int32))
+                jax.block_until_ready(length)
+                _count_transfer("h2d", 256 * 8)
+                device_stats["lut_uploads"] += 1
+                self._luts = (code, length)
+            return self._luts
+
+
+def launch_chunk(payloads: Sequence, table: DeflateTable,
+                 cw: Optional[int] = None):
+    """Pack one <=128-lane payload chunk into a pooled staging arena
+    and launch the batched encoder; returns an opaque handle for
+    ``fetch_chunk``.  Payloads may be ``memoryview`` slices — nothing
+    here copies the uncompressed bytes besides the arena pack."""
+    import jax.numpy as jnp
+
+    from disq_tpu.ops import inflate_simd as IS
+
+    if cw is None:
+        cw = bucket_for(payloads)
+    arena = IS.ARENAS.acquire(("deflate", cw), lambda: IS._PackArena(cw))
+    try:
+        comp, clen = IS._pack_chunk(payloads, cw, arena)
+        _count_transfer("h2d", comp.nbytes + clen.nbytes)
+        code_lut, len_lut = table.luts()
+        fn = _compiled(cw, table.out_bytes)
+        device_stats["launches"] += 1
+        out = fn(jnp.asarray(comp), jnp.asarray(clen), code_lut,
+                 len_lut, jnp.int32(table.header_bits))
+    except BaseException:
+        IS.ARENAS.release(("deflate", cw), arena)
+        raise
+    return out, arena, cw
+
+
+def release_chunk_arena(handle) -> None:
+    from disq_tpu.ops import inflate_simd as IS
+
+    _out, arena, cw = handle
+    IS.ARENAS.release(("deflate", cw), arena)
+
+
+def launch_resident(comp_cols, clen: np.ndarray,
+                    table: DeflateTable, cw: int):
+    """Launch the encoder over an ALREADY-device-resident (cw, 128)
+    word-column chunk (the fused resident-encode path,
+    ``runtime/device_write.py``): h2d is the (1,128) byte counts plus
+    the once-per-table LUTs — the payload bytes never re-upload."""
+    import jax.numpy as jnp
+
+    _count_transfer("h2d", clen.nbytes)
+    code_lut, len_lut = table.luts()
+    fn = _compiled(cw, table.out_bytes)
+    device_stats["launches"] += 1
+    out = fn(comp_cols, jnp.asarray(clen), code_lut, len_lut,
+             jnp.int32(table.header_bits))
+    return out, None, cw
+
+
+def fetch_chunk(handle, table: DeflateTable, lanes: int):
+    """Materialize one launched chunk under the synced kernel span:
+    the end-bit row first, then ONLY the occupied body prefix — d2h
+    carries compressed bytes, not the worst-case buffer (the inverse
+    of the readback-bound economics in the module header)."""
+    out = handle[0]
+    bodies_dev, end_dev = out
+    with _device_span("device.kernel", kernel="deflate_simd",
+                      lanes=lanes) as fence:
+        end = np.asarray(fence.sync(end_dev)).reshape(-1)
+        top = int(end[:lanes].max()) if lanes else 0
+        need = (top + table.eob_len + 7) // 8 + 2
+        # quantize the fetch width so slice shapes hit a small compile
+        # cache instead of one executable per chunk
+        need = min(table.out_bytes, (need + 1023) // 1024 * 1024)
+        bodies = np.asarray(bodies_dev[:, :need])
+    _count_transfer("d2h", bodies.nbytes + end.nbytes)
+    return bodies, end
 
 
 # ---------------------------------------------------------------------------
 # public: BGZF-framed device deflate
 
 
-def _bgzf_frame(stream: bytes, payload: bytes) -> bytes:
+def _bgzf_frame(stream: bytes, payload) -> bytes:
     from disq_tpu.bgzf.block import build_block_header
 
     bsize = 18 + len(stream) + 8
@@ -284,96 +464,182 @@ def _bgzf_frame(stream: bytes, payload: bytes) -> bytes:
     )
 
 
+frame_block = _bgzf_frame  # public alias (service / resident paths)
+
+
 def _stored_stream(payload: bytes) -> bytes:
     """BTYPE=00 stored block (the incompressible-data escape hatch)."""
     n = len(payload)
     return bytes([1]) + struct.pack("<HH", n, n ^ 0xFFFF) + payload
 
 
-#: Per-call observability (VERDICT r4 weak #6): how many blocks the
-#: entropy coder expanded and that fell back to stored (BTYPE=00).
-last_stats = {"blocks": 0, "stored_fallback": 0}
+def finalize_stream(body_row: np.ndarray, end_bit: int,
+                    table: DeflateTable) -> bytes:
+    """One lane of a fetched chunk → its raw DEFLATE stream: slice the
+    body bytes to the real length, OR in the shared header bits and the
+    trailing EOB code (codes never overlap in bit space, so OR is
+    exact)."""
+    total_bits = end_bit + table.eob_len
+    stream = bytearray(body_row[: (total_bits + 7) // 8].tobytes())
+    for k, hb in enumerate(table.header_bytes):
+        stream[k] |= hb
+    acc = table.eob_rev << (end_bit & 7)
+    for k in range((table.eob_len + (end_bit & 7) + 7) // 8):
+        if (end_bit >> 3) + k < len(stream):
+            stream[(end_bit >> 3) + k] |= (acc >> (8 * k)) & 0xFF
+    return bytes(stream)
 
 
-def deflate_blob_device(blob: bytes) -> Tuple[bytes, np.ndarray]:
+def host_deflate_stream(payload) -> bytes:
+    """Host-zlib fallback stream for a lane the entropy coder expanded:
+    the canonical level-6 raw deflate, degrading to a stored block when
+    zlib expands too (truly incompressible data).  Shares the BGZF
+    framing with the device lanes."""
+    c = zlib.compressobj(6, zlib.DEFLATED, -15, 8)
+    s = c.compress(payload) + c.flush()
+    if len(s) >= len(payload) + 5:
+        last_stats["stored_fallback"] += 1
+        return _stored_stream(bytes(payload))
+    return s
+
+
+def host_block(payload) -> bytes:
+    """One complete BGZF block via the host-zlib fallback (the
+    expanded/oversize escape hatch of the service and resident paths,
+    mirroring ``inflate_simd.host_inflate``)."""
+    return _bgzf_frame(host_deflate_stream(payload), payload)
+
+
+def expanded(stream: bytes, payload) -> bool:
+    """True when the entropy-coded stream is no smaller than a stored
+    block of the payload would be — the lane must reroute to host."""
+    return len(stream) >= len(payload) + 5
+
+
+def finalize_chunk(bodies: np.ndarray, end: np.ndarray,
+                   table: DeflateTable, payloads: Sequence,
+                   deliver, host_route) -> List[int]:
+    """The ONE per-lane finalize shared by every dispatch route
+    (``deflate_blob_device``, the service's ``_DeflateEngine``, the
+    resident ``EncodedShard.deflate``): slice + OR header/EOB, frame
+    device-encoded lanes through ``deliver(j, block)``, and hand the
+    entropy-expanded lane indices to ``host_route(flagged)`` — with
+    ALL accounting (``device.deflate.*`` counters, ``last_stats``,
+    ``device.host_fallback_blocks{reason=expanded}``) done here so the
+    three routes count identically: blocks/bytes_in/bytes_out cover
+    device-encoded lanes only; host fallbacks book under the fallback
+    counter, never the device byte totals."""
+    flagged: List[int] = []
+    n_dev = b_in = b_out = 0
+    for j, p in enumerate(payloads):
+        stream = finalize_stream(bodies[j], int(end[j]), table)
+        if expanded(stream, p):
+            flagged.append(j)
+            continue
+        block = _bgzf_frame(stream, p)
+        n_dev += 1
+        b_in += len(p)
+        b_out += len(block)
+        device_stats["device_blocks"] += 1
+        deliver(j, block)
+    if n_dev:
+        _counter("device.deflate.blocks").inc(n_dev)
+        _counter("device.deflate.bytes_in").inc(b_in)
+        _counter("device.deflate.bytes_out").inc(b_out)
+    if flagged:
+        last_stats["host_fallback"] += len(flagged)
+        _counter("device.host_fallback_blocks").inc(
+            len(flagged), reason="expanded")
+        host_route(flagged)
+    return flagged
+
+
+def deflate_blob_device(blob) -> Tuple[bytes, np.ndarray]:
     """Deflate a payload into BGZF blocks on device; returns
     (compressed bytes, per-block compressed sizes) — the same contract
-    as the canonical ``disq_tpu.bgzf.codec.deflate_blob``."""
-    import jax.numpy as jnp
+    as the canonical ``disq_tpu.bgzf.codec.deflate_blob``.
 
+    Dispatch shape (the inflate_simd layout): one shared Huffman table
+    per call from the global histogram (LUTs uploaded once, device-
+    resident across chunks), payload memoryviews packed into pooled
+    staging arenas in <=128-lane chunks, an adaptive window of launches
+    in flight, and a compressed-only d2h fetch per chunk.  Lanes the
+    entropy coder expanded reroute to host zlib (fanned over the shared
+    host pool when several flag at once) with
+    ``device.host_fallback_blocks{reason=expanded}`` accounting."""
     # reset first so an exception mid-encode can never leave a previous
     # call's counts attributed to this one
-    last_stats.update(blocks=0, stored_fallback=0)
+    last_stats.update(blocks=0, stored_fallback=0, host_fallback=0)
     if not blob:
         return b"", np.zeros(0, dtype=np.int64)
-    data = np.frombuffer(blob, dtype=np.uint8)
+    from disq_tpu.ops import inflate_simd as IS
+
+    data = (np.frombuffer(blob, dtype=np.uint8)
+            if not isinstance(blob, np.ndarray) else blob)
+    mv = memoryview(data)
     n_blocks = (len(data) + BLOCK_PAYLOAD - 1) // BLOCK_PAYLOAD
-    padded = np.zeros((n_blocks, BLOCK_PAYLOAD), dtype=np.uint8)
-    flat = padded.reshape(-1)
-    flat[: len(data)] = data
-    nbytes = np.minimum(
-        len(data) - BLOCK_PAYLOAD * np.arange(n_blocks), BLOCK_PAYLOAD
-    ).astype(np.int32)
+    payloads = [
+        mv[i * BLOCK_PAYLOAD: min((i + 1) * BLOCK_PAYLOAD, len(data))]
+        for i in range(n_blocks)
+    ]
+    # One shared table per call, from the global histogram (+EOB once
+    # per block): every block's header is bit-identical, so all lanes
+    # start their body at the same bit offset — which is what lets one
+    # batched kernel encode every lane.
+    table = DeflateTable(
+        np.bincount(data, minlength=256).astype(np.int64), n_blocks)
+    cw = bucket_for(payloads)
+    chunks = [payloads[lo: lo + LANES]
+              for lo in range(0, n_blocks, LANES)]
+    chunk_bytes = (cw + 1) * LANES * 4 + table.out_bytes * LANES
+    window = IS.dispatch_window(len(chunks), chunk_bytes)
+    blocks: List[Optional[bytes]] = [None] * n_blocks
+    launched: List[Any] = []
 
-    # One shared table per call, from the global histogram (+EOB once).
-    freq = np.bincount(data, minlength=256).astype(np.int64)
-    lit_freq = np.concatenate([freq, [n_blocks]])
-    lit_lens = limited_huffman_lengths(lit_freq, _MAX_BITS)
-    # A non-empty blob always yields ≥2 present symbols (a literal plus
-    # EOB), which zlib's dynamic-block decoder requires.
-    assert np.count_nonzero(lit_lens) >= 2
-    lit_codes = canonical_codes(lit_lens)
-    dist_lens = np.array([1], dtype=np.int32)  # single 1-bit distance code
-    header_acc, header_bits = build_dynamic_header(lit_lens, dist_lens)
+    def host_route_at(base: int):
+        # expanded lanes reroute to host zlib — off the caller's
+        # critical path when several flag at once (mirrors the inflate
+        # service's host fan-out)
+        def route(flagged: List[int]) -> None:
+            def one(j: int) -> None:
+                blocks[base + j] = host_block(payloads[base + j])
 
-    rev = _reverse_bits(lit_codes, lit_lens)
-    code_lut = jnp.asarray(rev[:256].astype(np.uint32))
-    len_lut = jnp.asarray(lit_lens[:256].astype(np.int32))
-    eob_rev, eob_len = int(rev[_EOB]), int(lit_lens[_EOB])
+            if len(flagged) > 2:
+                from disq_tpu.util import shared_host_pool
 
-    # Buffer bound from the ACTUAL max literal code length (readback is
-    # the bottleneck — see module docstring), with a generous static
-    # header allowance; rounded up to 8 KiB buckets so out_bytes (a
-    # static jit arg) hits a handful of compiled variants, not one per
-    # payload histogram. base_bits stays traced for the same reason.
-    # 4096-bit header allowance covers the RFC-worst dynamic header
-    # (~3700 bits: 258 CL-coded lengths at ≤7 bits plus extras).
-    max_code = int(lit_lens[:256].max())
-    assert header_bits < 4096
-    out_bytes = (4096 + BLOCK_PAYLOAD * max_code + _MAX_BITS) // 8 + 2
-    out_bytes = (out_bytes + 8191) // 8192 * 8192
-    bodies, end_bits = _encode_bodies(
-        jnp.asarray(padded), jnp.asarray(nbytes), code_lut, len_lut,
-        jnp.int32(header_bits), int(out_bytes),
-    )
-    bodies = np.asarray(bodies)
-    end_bits = np.asarray(end_bits)
+                for _ in shared_host_pool().map(one, flagged):
+                    pass
+            else:
+                for j in flagged:
+                    one(j)
 
-    header_bytes = header_acc.to_bytes((header_bits + 7) // 8, "little")
+        return route
+
+    try:
+        for ids in chunks[:window]:
+            launched.append(launch_chunk(ids, table, cw))
+        for ci, chunk in enumerate(chunks):
+            handle = launched[ci]
+            bodies, end = fetch_chunk(handle, table, len(chunk))
+            launched[ci] = None
+            release_chunk_arena(handle)
+            if ci + window < len(chunks):
+                launched.append(
+                    launch_chunk(chunks[ci + window], table, cw))
+            base = ci * LANES
+            finalize_chunk(
+                bodies, end, table, chunk,
+                lambda j, blk, base=base: blocks.__setitem__(
+                    base + j, blk),
+                host_route_at(base))
+    finally:
+        for entry in launched:
+            if entry is not None:
+                release_chunk_arena(entry)
     out = bytearray()
     sizes = np.empty(n_blocks, dtype=np.int64)
-    n_stored = 0
     for i in range(n_blocks):
-        payload_i = flat[i * BLOCK_PAYLOAD: i * BLOCK_PAYLOAD + int(nbytes[i])]
-        pay_b = payload_i.tobytes()
-        # OR header bits + EOB code into the device-written body bytes;
-        # slice to the real stream length first (the buffer is sized for
-        # the 15-bits-per-byte worst case).
-        e = int(end_bits[i])
-        total_bits = e + eob_len
-        stream = bytearray(bodies[i, : (total_bits + 7) // 8].tobytes())
-        for k, hb in enumerate(header_bytes):
-            stream[k] |= hb
-        acc = eob_rev << (e & 7)
-        for k in range((eob_len + (e & 7) + 7) // 8):
-            if (e >> 3) + k < len(stream):
-                stream[(e >> 3) + k] |= (acc >> (8 * k)) & 0xFF
-        stream = bytes(stream)
-        if len(stream) >= int(nbytes[i]) + 5:
-            stream = _stored_stream(pay_b)  # entropy coding expanded it
-            n_stored += 1
-        block = _bgzf_frame(stream, pay_b)
-        sizes[i] = len(block)
-        out += block
-    last_stats.update(blocks=n_blocks, stored_fallback=n_stored)
+        sizes[i] = len(blocks[i])
+        out += blocks[i]
+    last_stats["blocks"] = n_blocks
     return bytes(out), sizes

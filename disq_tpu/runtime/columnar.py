@@ -162,6 +162,10 @@ class ColumnarBatch:
         self._blob: Optional[np.ndarray] = None
         self._blob_parts: Optional[List[np.ndarray]] = None
         self._offsets: Optional[np.ndarray] = None
+        # record permutation applied by ``permuted()`` (None = source
+        # order): the device columns are already gathered by it; host
+        # ragged/interop apply it lazily
+        self._order: Optional[np.ndarray] = None
         self._n_ref: Optional[int] = None
         self._cache: Dict[str, np.ndarray] = {}
         self._consumed: Dict[str, int] = {}
@@ -361,9 +365,12 @@ class ColumnarBatch:
                 if self._ragged_rb is None:
                     from disq_tpu.bam.codec import decode_records
 
-                    self._ragged_rb = decode_records(
+                    rb = decode_records(
                         self._host_blob(), self._offsets,
                         n_ref=self._n_ref)
+                    if self._order is not None:
+                        rb = rb.take(self._order)
+                    self._ragged_rb = rb
         return self._ragged_rb
 
     def __getattr__(self, name: str):
@@ -377,13 +384,14 @@ class ColumnarBatch:
         """Spill as HOST data, never as device arrays: pickling the
         resident columns would be an uncounted implicit d2h, and the
         restored copy would re-book their avoidance on release. A
-        device-backed batch spills its host blob + offsets and re-runs
-        the fused build on load (a resumed resident read stays
-        device-backed with fresh, correct accounting); a host-backed
-        one spills its plain ``ReadBatch``."""
+        device-backed batch spills its host blob + offsets (plus any
+        ``permuted()`` order) and re-runs the fused build on load (a
+        resumed resident read stays device-backed with fresh, correct
+        accounting); a host-backed one spills its plain ``ReadBatch``."""
         if self._blob is not None or self._blob_parts is not None:
             return (_rebuild_from_blob,
-                    (self._host_blob(), self._offsets, self._n_ref))
+                    (self._host_blob(), self._offsets, self._n_ref,
+                     self._order))
         return (_rebuild_from_host, (self.to_read_batch(),))
 
     # -- ReadBatch interop --------------------------------------------------
@@ -502,6 +510,62 @@ class ColumnarBatch:
         # the 8-byte-per-record key vector stayed on device
         self._consume_on_device("sort_keys", 8 * self._n)
         return out
+
+    # -- resident permutation (the device write path's sort output) ---------
+
+    def permuted(self, order: np.ndarray) -> "ColumnarBatch":
+        """A reordered batch that STAYS device-backed: the fixed
+        columns are gathered by ``order`` on device (one small index
+        upload, zero column round-trips), and the host record blob is
+        kept with the permutation so ragged access materializes
+        lazily — exactly like the unpermuted batch.  This is the sort
+        output the symmetric write path consumes: its
+        ``encode_source()`` triple feeds ``runtime/device_write``'s
+        resident encode → deflate chain with no host record
+        materialization.  Falls back to a host-backed batch when the
+        device columns are gone (released / host-built)."""
+        order = np.asarray(order, dtype=np.int64)
+        if len(order) != self._n:
+            raise ValueError(
+                f"permutation of {len(order)} over {self._n} records")
+        dev = self._dev_snapshot()
+        if dev is None or self._offsets is None:
+            return ColumnarBatch.from_host(self.to_read_batch().take(order))
+        from disq_tpu.runtime.tracing import count_transfer, track_hbm
+
+        fns = _jax_fns()
+        jnp = fns["jnp"]
+        base = self._order[order] if self._order is not None else order
+        pad = _bucket_n(self._n) - self._n
+        idx_host = np.empty(self._n + pad, np.int32)
+        idx_host[: self._n] = order
+        idx_host[self._n:] = order[-1] if self._n else 0
+        count_transfer("h2d", idx_host.nbytes)
+        idx = jnp.asarray(idx_host)
+        out = ColumnarBatch.__new__(ColumnarBatch)
+        ColumnarBatch.__init__(out)
+        out._n = self._n
+        out._n_ref = self._n_ref
+        out._dev = {name: dev[name][idx] for name in FIXED_COLUMNS}
+        out._blob = self._blob
+        out._blob_parts = self._blob_parts
+        out._offsets = self._offsets
+        out._order = base
+        out._hbm = len(out._dev) * (self._n + pad) * 4
+        track_hbm(out._hbm)
+        _note_build(out._hbm)
+        return out
+
+    def encode_source(self):
+        """The ``(record blob, record offsets, permutation-or-None)``
+        triple the resident encode path needs, or None when this batch
+        holds no host record blob (host-built batches encode through
+        the classic ``encode_records`` path)."""
+        with self._lock:
+            if self._offsets is None or (
+                    self._blob is None and self._blob_parts is None):
+                return None
+        return self._host_blob(), self._offsets, self._order
 
     # -- concat -------------------------------------------------------------
 
@@ -625,10 +689,14 @@ class ColumnarBatch:
             pass
 
 
-def _rebuild_from_blob(blob, offsets, n_ref) -> "ColumnarBatch":
+def _rebuild_from_blob(blob, offsets, n_ref,
+                       order=None) -> "ColumnarBatch":
     """Unpickle target for a spilled device-backed batch (module-level
     so pickle resolves it by name)."""
-    return ColumnarBatch.from_blob(blob, offsets, n_ref=n_ref)
+    batch = ColumnarBatch.from_blob(blob, offsets, n_ref=n_ref)
+    if order is not None and isinstance(batch, ColumnarBatch):
+        batch = batch.permuted(order)
+    return batch
 
 
 def _rebuild_from_host(batch: ReadBatch) -> "ColumnarBatch":

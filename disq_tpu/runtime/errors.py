@@ -188,6 +188,17 @@ class DisqOptions:
     # DISQ_TPU_RESIDENT_DECODE. Off (default) ⇒ plain host ReadBatch
     # and zero device allocations (check_overhead-guarded).
     resident_decode: bool = False
+    # Symmetric device write path (ops/deflate + runtime/device_write):
+    # every sink's BGZF deflate routes through the 128-lane SIMD
+    # encoder (service-coalesced across write shards when the device
+    # service is up), and a sorted device-backed ColumnarBatch encodes
+    # its records on device so sort → encode → deflate never
+    # materializes host records — only compressed blocks cross d2h.
+    # Output is byte-VALID BGZF but not byte-identical to the host
+    # zlib pin. Env equivalent: DISQ_TPU_DEVICE_DEFLATE. Off (default)
+    # ⇒ canonical host zlib and zero device allocations
+    # (check_overhead-guarded).
+    device_deflate: bool = False
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -266,6 +277,9 @@ class DisqOptions:
 
     def with_resident_decode(self, enable: bool = True) -> "DisqOptions":
         return replace(self, resident_decode=bool(enable))
+
+    def with_device_deflate(self, enable: bool = True) -> "DisqOptions":
+        return replace(self, device_deflate=bool(enable))
 
 
 class CorruptBlockError(ValueError):

@@ -31,7 +31,8 @@ def coordinate_keys(refid: np.ndarray, pos: np.ndarray) -> np.ndarray:
     )
 
 
-def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
+def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True,
+                          keep_resident: bool = False) -> ReadBatch:
     """Sort a batch into coordinate order.
 
     A device-backed ``ColumnarBatch`` (the HBM-resident fused-decode
@@ -42,6 +43,14 @@ def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
     device is attached (psum/all_to_all exchange,
     ``disq_tpu.sort.sharded``); ragged columns are reordered host-side
     by one vectorized segment gather either way.
+
+    ``keep_resident`` (the symmetric write path) returns
+    ``batch.permuted(order)`` instead of materializing host records:
+    the sorted batch stays a device-backed ``ColumnarBatch`` whose
+    fixed columns were permuted on device and whose record bytes feed
+    the resident encode → deflate chain (``runtime/device_write.py``)
+    — whether the permutation came from the single-chip lexsort or the
+    multi-chip psum/all_to_all exchange.
     """
     from disq_tpu.runtime.columnar import ColumnarBatch
 
@@ -49,8 +58,14 @@ def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
         if batch.device_backed and batch.count > 0:
             # resident sort-key extraction: byte-identical to the host
             # argsort (same key, both stable), zero key traffic
-            return batch.take(batch.sort_permutation())
+            order = batch.sort_permutation()
+            if keep_resident and batch.encode_source() is not None:
+                return batch.permuted(order)
+            return batch.take(order)
+        resident_src = batch if keep_resident else None
         batch = batch.to_read_batch()
+    else:
+        resident_src = None
     keys = coordinate_keys(batch.refid, batch.pos)
     order = None
     if use_mesh and batch.count > 0:
@@ -66,4 +81,6 @@ def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True) -> ReadBatch:
             _, order = sharded_coordinate_sort(keys)
     if order is None:
         order = np.argsort(keys, kind="stable")
+    if resident_src is not None and resident_src.encode_source() is not None:
+        return resident_src.permuted(order)
     return batch.take(order)

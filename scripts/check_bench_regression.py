@@ -55,6 +55,10 @@ THROUGHPUT_KEYS = ("records_per_sec", "mb_per_sec", "staged_records_per_sec")
 # configs, so it earns a wider band before its own spread is added.
 CONFIG_TOLERANCE = {
     "10_resident_decode": 0.25,
+    # Config 11 runs the full sort+write+BAI chain (resident encode +
+    # device deflate, service-coalesced) on a real chip at 3 reps —
+    # the same device-queue wobble as config 10 plus filesystem noise.
+    "11_device_write": 0.25,
 }
 
 

@@ -90,6 +90,11 @@ CATEGORIES = (
     # oldest-lane wait of each flushed chunk — lanes sitting batched
     # before their kernel launched.
     ("service_wait", "w", ("device.service.wait",)),
+    # Symmetric device write path (ops/deflate.py +
+    # runtime/device_write.py): Huffman table builds and resident
+    # encode→deflate chunks — the write-side device work, separable
+    # from read-side kernels in the verdict.
+    ("device_write", "W", ("device.deflate.",)),
     # HBM-resident fused decode (runtime/columnar.py): ColumnarBatch
     # build (upload-or-in-place parse chain), lazy per-column fetches,
     # and release events carrying the batch's d2h-avoided bytes.
@@ -342,8 +347,8 @@ STALL_CATEGORIES = {"emit_stall", "retry", "quarantine", "watchdog"}
 # it only wins instants where nothing else is making progress — and
 # hedge-wasted time ranks last among work: it is burned concurrency,
 # attributed to its own bucket so the --analyze verdict can name it.
-WORK_PRIORITY = ("device", "transfer", "columnar", "decode", "encode",
-                 "deflate",
+WORK_PRIORITY = ("device", "transfer", "device_write", "columnar",
+                 "decode", "encode", "deflate",
                  "stage", "fetch", "hedge", "hedge_wasted",
                  # service queue wait ranks last: it only wins instants
                  # where nothing is making progress — lanes parked in
@@ -377,6 +382,13 @@ ADVICE = {
                     "batched while the device idles — lower "
                     "DISQ_TPU_SERVICE_FLUSH_MS, or raise "
                     "executor_workers so more shards feed the batcher",
+    "device_write": "device encode/deflate dominates the write: raise "
+                    "writer_workers so shards overlap launches, route "
+                    "through the service (DISQ_TPU_DEVICE_SERVICE=1) "
+                    "to coalesce partial chunks, or check "
+                    "device.host_fallback_blocks{reason=expanded} — "
+                    "incompressible lanes rerouting to host zlib eat "
+                    "the win",
     "columnar": "resident-decode build/fetch dominates: columns are "
                 "being materialized host-side after all — check which "
                 "consumer forces the fetches, or widen shards so one "

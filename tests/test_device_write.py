@@ -1,0 +1,338 @@
+"""Symmetric device write path tests (ISSUE 12).
+
+Oracles share no code with the encoder: every stream must inflate with
+stdlib zlib (and re-read through the framework's own readers) back to
+the exact records the host write path produces.  Byte-VALIDITY, not
+byte-identity, is the contract versus the host zlib pin — record
+identity after a round trip is what gets asserted.
+"""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from disq_tpu import DisqOptions, ReadsStorage
+from disq_tpu.api import BaiWriteOption, Interval, TraversalParameters
+from disq_tpu.bgzf.block import parse_block_header
+from disq_tpu.bgzf.codec import decompress_bgzf, deflate_blob
+from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+N_REC = 150
+
+
+@pytest.fixture()
+def bam_path(tmp_path):
+    data = make_bam_bytes(
+        DEFAULT_REFS, synth_records(N_REC, seed=11, unmapped_tail=3),
+        blocksize=900)
+    p = tmp_path / "in.bam"
+    p.write_bytes(data)
+    return str(p)
+
+
+def _read_columns(path):
+    ds = ReadsStorage.make_default().read(path)
+    b = ds.reads
+    return {
+        "refid": np.asarray(b.refid), "pos": np.asarray(b.pos),
+        "flag": np.asarray(b.flag), "mapq": np.asarray(b.mapq),
+        "names": np.asarray(b.names), "seqs": np.asarray(b.seqs),
+        "quals": np.asarray(b.quals), "cigars": np.asarray(b.cigars),
+        "tags": np.asarray(b.tags), "tlen": np.asarray(b.tlen),
+    }
+
+
+def _assert_same_records(a, b):
+    for k in a:
+        assert np.array_equal(a[k], b[k]), f"column {k} differs"
+
+
+def _zlib_walk(comp: bytes) -> bytes:
+    """Independent per-block decode: strip BGZF framing, raw zlib."""
+    out, pos = bytearray(), 0
+    while pos < len(comp):
+        total = parse_block_header(comp, pos)
+        xlen = struct.unpack_from("<H", comp, pos + 10)[0]
+        stream = comp[pos + 12 + xlen: pos + total - 8]
+        crc, isize = struct.unpack_from("<II", comp, pos + total - 8)
+        payload = zlib.decompress(stream, -15) if stream else b""
+        assert len(payload) == isize and zlib.crc32(payload) == crc
+        out += payload
+        pos += total
+    return bytes(out)
+
+
+class TestServiceRoutedDeflate:
+    def test_service_blob_roundtrip(self, monkeypatch):
+        from disq_tpu.runtime import device_service
+
+        monkeypatch.setenv("DISQ_TPU_DEVICE_DEFLATE", "1")
+        monkeypatch.setenv("DISQ_TPU_DEVICE_SERVICE", "1")
+        rng = np.random.default_rng(1)
+        payload = (b"quality-run " * 9000
+                   + rng.integers(0, 16, 70_000, np.uint8).tobytes())
+        try:
+            comp, sizes = deflate_blob(payload)
+        finally:
+            device_service.shutdown_service()
+        assert int(sizes.sum()) == len(comp)
+        assert _zlib_walk(comp) == payload
+        assert decompress_bgzf(comp) == payload
+
+    def test_cross_shard_submissions_stay_isolated(self, monkeypatch):
+        """Concurrent submissions co-batch into shared launches; every
+        owner gets exactly its own blocks back, in order."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from disq_tpu.runtime import device_service
+
+        monkeypatch.setenv("DISQ_TPU_DEVICE_SERVICE", "1")
+        blobs = [bytes([65 + i]) * (30_000 + 1000 * i) for i in range(6)]
+        try:
+            with ThreadPoolExecutor(max_workers=6) as pool:
+                outs = list(pool.map(
+                    lambda b: deflate_blob(b, device=True), blobs))
+        finally:
+            device_service.shutdown_service()
+        for blob, (comp, sizes) in zip(blobs, outs):
+            assert _zlib_walk(comp) == blob
+            assert int(sizes.sum()) == len(comp)
+
+    def test_submit_deflate_rejects_oversize_payload(self, monkeypatch):
+        """Encode has no oversize escape hatch (nothing can frame
+        >65280 bytes as one BGZF block) — the service must raise at
+        submit time, on the caller's thread."""
+        from disq_tpu.runtime import device_service
+
+        monkeypatch.setenv("DISQ_TPU_DEVICE_SERVICE", "1")
+        svc = device_service.get_service()
+        try:
+            with pytest.raises(ValueError, match="too large"):
+                svc.submit_deflate([b"x" * 65281])
+        finally:
+            device_service.shutdown_service()
+
+    @pytest.mark.parametrize("workers", [1, 4, 8])
+    def test_writer_workers_roundtrip(self, bam_path, tmp_path,
+                                      monkeypatch, workers):
+        from disq_tpu.runtime import device_service
+
+        host = _read_columns(bam_path)
+        out = str(tmp_path / f"dev-w{workers}.bam")
+        monkeypatch.setenv("DISQ_TPU_DEVICE_SERVICE", "1")
+        ds = ReadsStorage.make_default().read(bam_path)
+        try:
+            (ReadsStorage.make_default().num_shards(5)
+             .device_deflate().writer_workers(workers)
+             .write(ds, out))
+        finally:
+            device_service.shutdown_service()
+        # the repo's own reader must re-read identical records
+        _assert_same_records(host, _read_columns(out))
+        # and every block must be plain-zlib decodable
+        with open(out, "rb") as f:
+            _zlib_walk(f.read())
+
+
+class TestVoffsetIdentity:
+    def test_device_csizes_feed_valid_voffsets(self):
+        """Every record voffset computed from the DEVICE csizes must
+        seek (via the framework's BgzfReader) to that record's exact
+        bytes."""
+        import io
+
+        from disq_tpu.bgzf.block import BGZF_EOF_MARKER
+        from disq_tpu.bgzf.codec import BgzfReader
+        from disq_tpu.bam.sink import bgzf_compress_with_voffsets
+
+        rng = np.random.default_rng(7)
+        rec_lens = rng.integers(40, 200, 800)
+        offs = np.zeros(len(rec_lens) + 1, np.int64)
+        np.cumsum(rec_lens, out=offs[1:])
+        blob = rng.integers(0, 24, int(offs[-1]), np.uint8).tobytes()
+        comp, voffs, end_voffs = bgzf_compress_with_voffsets(
+            blob, offs, device=True)
+        reader = BgzfReader(io.BytesIO(comp + BGZF_EOF_MARKER))
+        for i in range(0, len(rec_lens), 97):
+            reader.seek_virtual(int(voffs[i]))
+            want = blob[int(offs[i]): int(offs[i + 1])]
+            assert reader.read_exact(len(want)) == want
+
+    def test_bai_from_device_write_serves_intervals(self, bam_path,
+                                                    tmp_path):
+        host_out = str(tmp_path / "host.bam")
+        dev_out = str(tmp_path / "dev.bam")
+        ds = ReadsStorage.make_default().read(bam_path)
+        st = ReadsStorage.make_default().num_shards(4)
+        st.write(ds, host_out, BaiWriteOption.ENABLE, sort=True)
+        (ReadsStorage.make_default().num_shards(4).device_deflate()
+         .write(ds, dev_out, BaiWriteOption.ENABLE, sort=True))
+        assert os.path.exists(dev_out + ".bai")
+        tp = TraversalParameters(intervals=(
+            Interval("chr1", 1, 60_000), Interval("chrM", 1, 16_000)))
+        got = ReadsStorage.make_default().read(dev_out, traversal=tp)
+        want = ReadsStorage.make_default().read(host_out, traversal=tp)
+        assert got.count() == want.count()
+        assert np.array_equal(np.asarray(got.reads.pos),
+                              np.asarray(want.reads.pos))
+        assert np.array_equal(np.asarray(got.reads.names),
+                              np.asarray(want.reads.names))
+
+
+class TestResidentEncode:
+    def _columnar(self, bam_path):
+        opts = DisqOptions(resident_decode=True)
+        ds = ReadsStorage.make_default().options(opts).read(bam_path)
+        from disq_tpu.runtime.columnar import ColumnarBatch
+
+        assert isinstance(ds.reads, ColumnarBatch)
+        assert ds.reads.device_backed
+        return ds
+
+    def test_resident_encode_bytes_match_host_encoder(self, bam_path):
+        """Inflated resident-encode output must be byte-identical to
+        the host encoder run on the same (sorted) records."""
+        from disq_tpu.bam.codec import encode_records_with_offsets
+        from disq_tpu.runtime.device_write import ResidentShardEncoder
+
+        ds = self._columnar(bam_path)
+        order = ds.reads.sort_permutation()
+        perm = ds.reads.permuted(order)
+        assert perm.device_backed and perm.encode_source() is not None
+        host_sorted = ReadsStorage.make_default().read(
+            bam_path).reads.take(order)
+        want_blob, want_offs = encode_records_with_offsets(host_sorted)
+        enc = ResidentShardEncoder(perm)
+        try:
+            for lo, hi in ((0, perm.count), (0, perm.count // 2),
+                           (perm.count // 2, perm.count)):
+                shard = enc.encode_shard(lo, hi)
+                comp, csizes = shard.deflate()
+                got = _zlib_walk(comp)
+                want = bytes(want_blob)[int(want_offs[lo]):
+                                        int(want_offs[hi])]
+                assert got == want
+                assert np.array_equal(
+                    np.asarray(shard.record_offsets),
+                    want_offs[lo: hi + 1] - want_offs[lo])
+        finally:
+            enc.release()
+
+    def test_end_to_end_sorted_device_write(self, bam_path, tmp_path):
+        host_out = str(tmp_path / "host-sorted.bam")
+        dev_out = str(tmp_path / "dev-sorted.bam")
+        st_host = ReadsStorage.make_default().num_shards(4)
+        st_host.write(st_host.read(bam_path), host_out,
+                      BaiWriteOption.ENABLE, sort=True)
+        st_dev = (ReadsStorage.make_default().num_shards(4)
+                  .resident_decode().device_deflate())
+        ds = st_dev.read(bam_path)
+        st_dev.write(ds, dev_out, BaiWriteOption.ENABLE, sort=True)
+        _assert_same_records(_read_columns(host_out),
+                             _read_columns(dev_out))
+
+    def test_permuted_batch_interop(self, bam_path):
+        """The resident sort output stays duck-compatible: columns,
+        ragged access and to_read_batch all reflect the permutation."""
+        ds = self._columnar(bam_path)
+        order = ds.reads.sort_permutation()
+        perm = ds.reads.permuted(order)
+        host = ReadsStorage.make_default().read(bam_path).reads
+        want = host.take(order)
+        assert np.array_equal(np.asarray(perm.pos), want.pos)
+        assert np.array_equal(np.asarray(perm.flag), want.flag)
+        got_rb = perm.to_read_batch()
+        assert np.array_equal(got_rb.names, want.names)
+        assert np.array_equal(got_rb.seqs, want.seqs)
+        perm.release()
+
+
+class TestFaultInterplay:
+    def test_write_faults_retry_without_changing_bytes(
+            self, bam_path, tmp_path, monkeypatch):
+        from disq_tpu.fsw import (
+            FaultInjectingFileSystemWrapper,
+            FaultSpec,
+            PosixFileSystemWrapper,
+            register_filesystem,
+        )
+
+        register_filesystem("fault", FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(),
+            [FaultSpec(kind="transient", probability=0.25, op="write")],
+            seed=3))
+        ds = ReadsStorage.make_default().read(bam_path)
+        faulted = str(tmp_path / "dev-faulted.bam")
+        clean = str(tmp_path / "dev-clean.bam")
+        opts = DisqOptions(max_retries=8, retry_backoff_s=0.0,
+                           device_deflate=True, writer_workers=2)
+        (ReadsStorage.make_default().num_shards(5).options(opts)
+         .write(ds, "fault://" + faulted))
+        (ReadsStorage.make_default().num_shards(5).options(opts)
+         .write(ds, clean))
+        with open(faulted, "rb") as fa, open(clean, "rb") as fb:
+            assert fa.read() == fb.read()
+
+    def test_quarantined_read_then_device_write(self, bam_path,
+                                                tmp_path):
+        """A corrupt block quarantined on read loses exactly its own
+        records; the device write of the surviving dataset re-reads to
+        exactly those records — the owner shard's loss never spreads."""
+        from disq_tpu.bgzf.block import parse_block_header as pbh
+
+        data = open(bam_path, "rb").read()
+        # corrupt the DEFLATE payload of the 3rd block
+        layout, pos = [], 0
+        while pos < len(data):
+            layout.append(pos)
+            pos += pbh(data, pos)
+        bad = bytearray(data)
+        bad[layout[3] + 20] ^= 0xFF
+        bad_path = str(tmp_path / "bad.bam")
+        open(bad_path, "wb").write(bytes(bad))
+        opts = DisqOptions(
+            error_policy="quarantine",
+            quarantine_dir=str(tmp_path / "quar"))
+        ds = (ReadsStorage.make_default().options(opts)
+              .read(bad_path))
+        assert ds.counters.quarantined_blocks == 1
+        assert 0 < N_REC + 3 - ds.count() <= 40
+        out = str(tmp_path / "salvaged-dev.bam")
+        (ReadsStorage.make_default().num_shards(3).device_deflate()
+         .write(ds, out))
+        got = ReadsStorage.make_default().read(out)
+        assert got.count() == ds.count()
+        assert np.array_equal(np.asarray(got.reads.pos),
+                              np.asarray(ds.reads.pos))
+
+
+class TestDisabledPath:
+    def test_host_path_spawns_zero_device_work(self, bam_path,
+                                               tmp_path, monkeypatch):
+        monkeypatch.delenv("DISQ_TPU_DEVICE_DEFLATE", raising=False)
+        monkeypatch.delenv("DISQ_TPU_DEVICE_SERVICE", raising=False)
+        from disq_tpu.ops import deflate as dev_deflate
+        from disq_tpu.runtime import device_service
+
+        device_service.shutdown_service()
+        before = dict(dev_deflate.device_stats)
+        ds = ReadsStorage.make_default().read(bam_path)
+        (ReadsStorage.make_default().num_shards(4)
+         .write(ds, str(tmp_path / "host.bam"), BaiWriteOption.ENABLE,
+                sort=True))
+        assert dev_deflate.device_stats == before
+        assert device_service.service_if_running() is None
+
+    def test_default_options_do_not_arm_device_deflate(self):
+        from disq_tpu.bgzf.codec import device_deflate_enabled
+
+        class _S:
+            _options = DisqOptions()
+
+        assert not device_deflate_enabled(_S())
+        assert device_deflate_enabled.__call__(
+            type("T", (), {"_options": DisqOptions(
+                device_deflate=True)})())

@@ -287,8 +287,10 @@ def test_chaos_soak_smoke(executor_workers):
     fetches racing a seeded slow tail, byte identity + accounting),
     --breaker (fault storm trips / fails fast / recloses), --resident
     (HBM-resident fused decode under transient faults, byte-compared
-    after d2h against the host path), and --kill (SIGKILL a writer
-    mid-run, ledger-asserted resume)."""
+    after d2h against the host path), --device-write (resident encode
+    + service-routed SIMD deflate under write faults, record-compared
+    after re-read against the fault-free host path), and --kill
+    (SIGKILL a writer mid-run, ledger-asserted resume)."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
@@ -296,7 +298,8 @@ def test_chaos_soak_smoke(executor_workers):
         [sys.executable, script, "--iterations", "3", "--records", "200",
          "--seed", "7", "--executor-workers", str(executor_workers),
          "--writer-workers", str(executor_workers),
-         "--hedge", "--breaker", "--resident", "--kill"]
+         "--hedge", "--breaker", "--resident", "--device-write",
+         "--kill"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
