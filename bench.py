@@ -311,6 +311,58 @@ def executor_scaling_config(path: str, reps: int) -> dict:
     return {"6_bam_decode_executor_scaling": rows}
 
 
+def _range_server(bodies: dict, latency_s: float = 0.0):
+    """In-process HTTP range server over ``bodies`` ({path: bytes}) —
+    the zero-egress remote store the scaling configs read from.
+    Unknown paths 404 (an index-existence probe behaves like a store
+    without the object); ``latency_s`` sleeps per GET (simulated RTT).
+    Returns ``(server, base_url)``; caller owns ``server.shutdown()``."""
+    import threading
+    import time as _time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            body = bodies.get(self.path)
+            if body is None:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_GET(self):
+            body = bodies.get(self.path)
+            if body is None:
+                self.send_error(404)
+                return
+            if latency_s:
+                _time.sleep(latency_s)  # simulated remote RTT
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                lo, hi = rng[len("bytes="):].split("-")
+                lo, hi = int(lo), min(int(hi), len(body) - 1)
+                chunk = body[lo: hi + 1]
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range", f"bytes {lo}-{hi}/{len(body)}")
+            else:
+                chunk = body
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(chunk)))
+            self.end_headers()
+            self.wfile.write(chunk)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, name="disq-bench-http",
+                     daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
 def http_read_config(path: str, reps: int) -> dict:
     """Remote-read row: the bench BAM served by an in-process HTTP
     range server (zero egress), read at each ``executor_workers`` —
@@ -320,10 +372,6 @@ def http_read_config(path: str, reps: int) -> dict:
     regime BENCH_r05 showed to be latency-bound). A fresh wrapper per
     run keeps the block cache cold so every rep measures real
     range-request overlap, not cache hits."""
-    import threading
-    import time as _time
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
     from disq_tpu import ReadsStorage
     from disq_tpu.fsw import register_filesystem
     from disq_tpu.fsw.http import HttpFileSystemWrapper
@@ -331,44 +379,8 @@ def http_read_config(path: str, reps: int) -> dict:
     latency_s = float(os.environ.get("BENCH_HTTP_LATENCY_MS", "10")) / 1e3
     with open(path, "rb") as f:
         raw = f.read()
-
-    class Handler(BaseHTTPRequestHandler):
-        def log_message(self, *a):
-            pass
-
-        def do_HEAD(self):
-            if self.path != "/bench.bam":
-                self.send_error(404)  # e.g. the .sbi existence probe
-                return
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(raw)))
-            self.send_header("Accept-Ranges", "bytes")
-            self.end_headers()
-
-        def do_GET(self):
-            if self.path != "/bench.bam":
-                self.send_error(404)
-                return
-            _time.sleep(latency_s)  # simulated remote RTT
-            rng = self.headers.get("Range")
-            if rng and rng.startswith("bytes="):
-                lo, hi = rng[len("bytes="):].split("-")
-                lo, hi = int(lo), min(int(hi), len(raw) - 1)
-                body = raw[lo: hi + 1]
-                self.send_response(206)
-                self.send_header(
-                    "Content-Range", f"bytes {lo}-{hi}/{len(raw)}")
-            else:
-                body = raw
-                self.send_response(200)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-    threading.Thread(target=srv.serve_forever, name="disq-bench-http",
-                     daemon=True).start()
-    url = f"http://127.0.0.1:{srv.server_address[1]}/bench.bam"
+    srv, base = _range_server({"/bench.bam": raw}, latency_s=latency_s)
+    url = base + "/bench.bam"
     rows = {}
     try:
         for w in EXEC_WORKERS:
@@ -757,6 +769,176 @@ def device_write_config(path: str, tmp: str) -> dict:
     return {"11_device_write": rows}
 
 
+_SCHED_WORKER = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from disq_tpu import ReadsStorage
+from disq_tpu.fsw import (FaultInjectingFileSystemWrapper, FaultSpec,
+                          register_filesystem)
+from disq_tpu.fsw.http import HttpFileSystemWrapper
+
+# Worker 0 is the deliberate straggler: every range read through its
+# HTTP wrapper draws a seeded latency from [0, slow_s) — the faultfs
+# "slow" spec layered over the real remote wrapper.
+http = HttpFileSystemWrapper(block_size={block_size})
+slow_s = {slow_s}
+if slow_s > 0:
+    # scheme="slowhttp" never matches the http:// paths, so the fault
+    # wrapper passes full URLs through to the real HTTP wrapper
+    register_filesystem("http", FaultInjectingFileSystemWrapper(
+        http, [FaultSpec(kind="slow", probability=1.0, slow_s=slow_s)],
+        seed=13, scheme="slowhttp"))
+else:
+    register_filesystem("http", http)
+storage = ReadsStorage.make_default().split_size({split})
+
+# Driver phase (header read) runs BEFORE the barrier: it is identical
+# fixed cost in both modes and the scheduler has no lever over it —
+# the timed window is exactly the scheduled split loop.
+from disq_tpu.bam.source import BamSource, read_header
+from disq_tpu.fsw.filesystem import resolve_path
+
+src = BamSource(storage)
+fs, p = resolve_path({url!r})
+header, fv = read_header(fs, p)
+
+# Barrier start: interpreter/jax startup skew must not decide which
+# worker reaches the queue first — every worker signals readiness and
+# waits for the parent's go-file before the timed read.
+open({ready!r}, "w").write("1")
+while not os.path.exists({go!r}):
+    time.sleep(0.01)
+t0 = time.perf_counter()
+batches = src.read_split_batches(fs, p, header, fv)
+wall = time.perf_counter() - t0
+print(json.dumps({{"host": os.environ.get("DISQ_TPU_SCHED_HOST"),
+                   "records": int(sum(b.count for b in batches)),
+                   "wall": round(wall, 4)}}))
+"""
+
+
+def sched_steal_config(path: str, tmp: str) -> dict:
+    """Config 12: the cross-host shard scheduler
+    (``runtime/scheduler.py``) under a deliberate straggler — 1/2/4
+    subprocess workers reading the bench BAM off an in-process HTTP
+    range server, worker 0 slowed by a seeded faultfs ``slow`` tail on
+    every range read.
+
+    Two modes per width, both *through the scheduler plane* so they
+    pay identical RPC overhead: ``static`` assigns shard ``i`` to host
+    ``i mod N`` (the historical fixed split, no stealing) and ``sched``
+    runs the real queue with locality routing + work stealing.  Each
+    row reports aggregate records/sec (total records / slowest worker
+    wall), the straggler-tail ratio (slowest / median worker wall) and,
+    for ``sched``, the coordinator's locality hit-rate and steal count
+    — the closed loop behind "stealing recovers the straggler's
+    wall"."""
+    import statistics as _stats
+    import subprocess
+    import time as _time
+
+    from disq_tpu.runtime import scheduler
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    slow_ms = float(os.environ.get("BENCH_SCHED_SLOW_MS", "400"))
+    split = 512 * 1024
+    block_size = 256 * 1024
+    bodies = {"/bench.bam": open(path, "rb").read()}
+    if os.path.exists(path + ".sbi"):
+        bodies["/bench.bam.sbi"] = open(path + ".sbi", "rb").read()
+    srv, base = _range_server(bodies)
+    url = base + "/bench.bam"
+    coord = scheduler.serve_coordinator(lease_s=60.0, steal_after_s=0.1)
+
+    def run_mode(mode: str, w: int) -> dict:
+        salt = f"bench12-{mode}-w{w}"
+        procs, readies = [], []
+        go = os.path.join(tmp, f"go-{salt}")
+        for i in range(w):
+            ready = os.path.join(tmp, f"ready-{salt}-{i}")
+            readies.append(ready)
+            env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "DISQ_TPU_SCHED": coord,
+                   "DISQ_TPU_SCHED_HOST": f"w{i}",
+                   "DISQ_TPU_SCHED_LEASE_N": "2",
+                   "DISQ_TPU_SCHED_SALT": salt,
+                   "DISQ_TPU_SCHED_STEAL":
+                       "1" if mode == "sched" else "0"}
+            if mode == "static":
+                env["DISQ_TPU_SCHED_STATIC"] = f"{i},{w}"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _SCHED_WORKER.format(
+                    repo=repo, url=url, split=split,
+                    block_size=block_size,
+                    slow_s=(slow_ms / 1e3) if i == 0 else 0.0,
+                    ready=ready, go=go)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        deadline = _time.monotonic() + 300
+        while (_time.monotonic() < deadline
+               and not all(os.path.exists(r) for r in readies)):
+            _time.sleep(0.01)
+        open(go, "w").write("1")
+        docs = []
+        for proc in procs:
+            out, err = proc.communicate(timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"config 12 worker failed ({mode}, w={w}): "
+                    + err[-800:])
+            docs.append(json.loads(out.strip().splitlines()[-1]))
+        total = sum(d["records"] for d in docs)
+        assert total == N_RECORDS, (
+            f"config 12 {mode} w={w}: workers decoded {total} records, "
+            f"expected {N_RECORDS} (a shard emitted 0 or 2 times)")
+        walls = sorted(d["wall"] for d in docs)
+        row = {
+            "records_per_sec": round(total / walls[-1], 1),
+            "tail_ratio": round(walls[-1] / _stats.median(walls), 3),
+            "worker_walls_s": walls,
+        }
+        run = scheduler.active_coordinator().stats().get(
+            "runs", {}).get(f"{url}#{run_shards[0]}#{salt}")
+        if run is not None:
+            row["locality_hit_rate"] = run["locality_hit_rate"]
+            row["steals"] = len(run["stolen"])
+            row["requeued"] = len(run["requeued"])
+        return row
+
+    # shard count is fixed by (file size, split): read it back from the
+    # coordinator's first registered run for the stats join
+    run_shards = [None]
+
+    rows: dict = {"slow_worker_ms": slow_ms}
+    try:
+        for w in (1, 2, 4):
+            per_w: dict = {}
+            for mode in ("static", "sched"):
+                if run_shards[0] is None:
+                    # derive the shard count exactly as the sources do
+                    from disq_tpu.fsw.filesystem import compute_path_splits
+                    from disq_tpu.fsw.http import HttpFileSystemWrapper
+
+                    probe = HttpFileSystemWrapper(block_size=block_size)
+                    run_shards[0] = len(
+                        compute_path_splits(probe, url, split))
+                per_w[mode] = run_mode(mode, w)
+            per_w["sched_vs_static"] = round(
+                per_w["sched"]["records_per_sec"]
+                / per_w["static"]["records_per_sec"], 3)
+            per_w["tail_ratio_drop"] = round(
+                per_w["static"]["tail_ratio"]
+                / max(per_w["sched"]["tail_ratio"], 1e-9), 3)
+            rows[f"workers_{w}"] = per_w
+    finally:
+        # the process-wide introspection server stays up (other configs
+        # may serve it); only the coordinator state is dropped
+        srv.shutdown()
+        scheduler.stop_coordinator()
+    return {"12_sched_steal": rows}
+
+
 def main() -> None:
     # DISQ_TPU_POSTMORTEM_DIR arms the flight recorder for the whole
     # bench: any abort writes a postmortem bundle there, and
@@ -820,6 +1002,7 @@ def main() -> None:
     configs.update(executor_scaling_config(path, max(2, REPS - 2)))
     configs.update(http_read_config(path, max(2, REPS - 2)))
     configs.update(write_scaling_config(path, tmp, max(2, REPS - 2)))
+    configs.update(sched_steal_config(path, tmp))
     configs.update(device_inflate_config(path))
     configs.update(device_service_config(path))
     configs.update(resident_decode_config(path))

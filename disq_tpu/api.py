@@ -492,6 +492,36 @@ class ReadsStorage:
         self._options = self._options.with_profile(hz)
         return self
 
+    def scheduler(self, mode: str, lease_n: int = 2,
+                  lease_s: float = 10.0,
+                  steal: bool = True) -> "ReadsStorage":
+        """Join this storage's reads to the cross-host shard scheduler
+        (``runtime/scheduler.py``): ``mode="serve"`` hosts the shared
+        work-queue coordinator on this process's introspection endpoint
+        (and works); ``mode="host:port"`` joins that coordinator.
+        Workers lease ``lease_n`` shards at a time (locality-routed to
+        the host whose HTTP block cache holds their byte range), a
+        lease unfinished after ``lease_s`` seconds is re-queued (the
+        crash-handoff latency), and ``steal`` lets an idle worker take
+        stale leases from the most-loaded host.  Env equivalents:
+        ``DISQ_TPU_SCHED`` / ``DISQ_TPU_SCHED_LEASE_N`` /
+        ``DISQ_TPU_SCHED_LEASE_S`` / ``DISQ_TPU_SCHED_STEAL``."""
+        self._options = self._options.with_scheduler(
+            mode, lease_n, lease_s, steal)
+        return self
+
+    def http_cache_blocks(self, n: int) -> "ReadsStorage":
+        """Size the HTTP block-LRU (``fsw/http.py``; default 32
+        blocks): applied to every registered HTTP wrapper when a
+        pipeline built from this storage runs, and the default for
+        wrappers built later.  Occupancy is served on the
+        ``fsw.http.cache.blocks`` gauge — the signal the scheduler's
+        locality scorer (and an operator sizing the cache to the
+        workload) reads.  Env equivalent:
+        ``DISQ_TPU_HTTP_CACHE_BLOCKS``."""
+        self._options = self._options.with_http_cache_blocks(n)
+        return self
+
     def resident_decode(self, enable: bool = True) -> "ReadsStorage":
         """Arm the HBM-resident fused decode path
         (``runtime/columnar.py``): each shard's decoded blob is parsed
@@ -689,6 +719,22 @@ class VariantsStorage:
     def profile_hz(self, hz: float) -> "VariantsStorage":
         """See ``ReadsStorage.profile_hz``."""
         self._options = self._options.with_profile(hz)
+        return self
+
+    def scheduler(self, mode: str, lease_n: int = 2,
+                  lease_s: float = 10.0,
+                  steal: bool = True) -> "VariantsStorage":
+        """See ``ReadsStorage.scheduler``.  VCF reads lease their
+        splits from the shared queue; BCF keeps the static whole-file
+        path (its single BGZF stream cannot be partitioned across
+        hosts) exactly as it keeps strict deadline semantics."""
+        self._options = self._options.with_scheduler(
+            mode, lease_n, lease_s, steal)
+        return self
+
+    def http_cache_blocks(self, n: int) -> "VariantsStorage":
+        """See ``ReadsStorage.http_cache_blocks``."""
+        self._options = self._options.with_http_cache_blocks(n)
         return self
 
     def resident_decode(self, enable: bool = True) -> "VariantsStorage":

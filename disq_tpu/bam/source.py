@@ -150,7 +150,6 @@ class BamSource:
         )
         from disq_tpu.runtime.executor import (
             executor_for_storage,
-            map_ordered_resumable,
             read_ledger_for_storage,
         )
 
@@ -181,13 +180,21 @@ class BamSource:
                 deadline_fallback=deadline_fallback_for(
                     opts, shard_ctx,
                     lambda: (ReadBatch.empty(), (0, 0, 0))),
+                # Compressed byte window (coffsets) — the scheduler's
+                # locality coordinate.
+                byte_range=(lo >> 16, (hi >> 16) + 1),
             ))
         from disq_tpu.runtime.introspect import note_shard_counters
+        from disq_tpu.runtime.scheduler import scheduled_map_ordered
 
         out = []
         self._last_counters = []
         ledger = read_ledger_for_storage(self._storage, path, len(tasks))
-        for res in map_ordered_resumable(
+        # scheduler off (default): scheduled_map_ordered IS
+        # map_ordered_resumable; on: this process leases shards from
+        # the shared cross-host queue and emits only the ones it wins.
+        for res in scheduled_map_ordered(
+                self._storage, fs, path,
                 executor_for_storage(self._storage), tasks, ledger):
             batch, stats = res.value
             shard_ctx = shard_ctxs[res.shard_id]

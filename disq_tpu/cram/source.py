@@ -110,7 +110,6 @@ class CramSource:
         )
         from disq_tpu.runtime.executor import (
             executor_for_storage,
-            map_ordered_resumable,
             read_ledger_for_storage,
         )
         from disq_tpu.runtime.tracing import wrap_span
@@ -148,13 +147,20 @@ class CramSource:
                 # aside as zero containers instead of aborting.
                 deadline_fallback=deadline_fallback_for(
                     opts, shard_ctx, list),
+                # Scheduler locality coordinate (split byte window).
+                byte_range=(s.start, s.end),
             ))
         from disq_tpu.runtime.introspect import note_shard_counters
+        from disq_tpu.runtime.scheduler import scheduled_map_ordered
 
         batches = []
         shard_counters = []
         ledger = read_ledger_for_storage(self._storage, path, len(tasks))
-        for res in map_ordered_resumable(
+        # scheduler off (default): falls through to
+        # map_ordered_resumable; on: container splits lease from the
+        # shared cross-host queue.
+        for res in scheduled_map_ordered(
+                self._storage, fs, path,
                 executor_for_storage(self._storage), tasks, ledger):
             shard_batches = res.value
             shard_ctx = shard_ctxs[res.shard_id]

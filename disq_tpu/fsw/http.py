@@ -29,18 +29,61 @@ committer; our sinks stage locally and upload out-of-band).
 from __future__ import annotations
 
 import io
+import os
 import threading
 import urllib.error
 import urllib.request
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import BinaryIO, List, Tuple
+from typing import BinaryIO, List, Optional, Tuple
 
 from disq_tpu.fsw.filesystem import FileSystemWrapper
 from disq_tpu.runtime.tracing import counter as _counter
+from disq_tpu.runtime.tracing import observe_gauge as _observe_gauge
 from disq_tpu.runtime.tracing import span as _span
 
 DEFAULT_BLOCK = 4 * 1024 * 1024
+DEFAULT_CACHED_BLOCKS = 32
+
+# Process-wide cache-capacity override installed by
+# ``configure_cache_blocks`` (DisqOptions.http_cache_blocks): applied
+# to every registered wrapper AND to wrappers constructed later.
+_configured_cache_blocks: Optional[int] = None
+
+
+def _default_cache_blocks() -> int:
+    """Capacity resolution for a wrapper built without an explicit
+    ``max_cached_blocks``: the options-installed override, then
+    ``DISQ_TPU_HTTP_CACHE_BLOCKS``, then the built-in 32."""
+    if _configured_cache_blocks is not None:
+        return _configured_cache_blocks
+    raw = os.environ.get("DISQ_TPU_HTTP_CACHE_BLOCKS")
+    if raw:
+        try:
+            n = int(raw)
+            if n >= 1:
+                return n
+        except ValueError:
+            pass
+    return DEFAULT_CACHED_BLOCKS
+
+
+def configure_cache_blocks(n: int) -> None:
+    """Size the HTTP block-LRU process-wide (``DisqOptions.
+    http_cache_blocks`` plumbing): updates every registered HTTP
+    wrapper (including ones wrapped by the fault injector) and becomes
+    the default for wrappers constructed later."""
+    global _configured_cache_blocks
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"http cache capacity must be >= 1, got {n}")
+    _configured_cache_blocks = n
+    from disq_tpu.fsw import filesystem
+
+    for fs in list(filesystem._SCHEME_REGISTRY.values()):
+        inner = getattr(fs, "inner", fs)
+        if isinstance(inner, HttpFileSystemWrapper):
+            inner.set_max_cached_blocks(n)
 
 
 def rewrite_remote_uri(path: str) -> str:
@@ -77,10 +120,17 @@ class HttpFileSystemWrapper(FileSystemWrapper):
     """Read-only remote FSW over HTTP range requests."""
 
     def __init__(self, block_size: int = DEFAULT_BLOCK,
-                 prefetch: bool = True, max_cached_blocks: int = 32) -> None:
+                 prefetch: bool = True,
+                 max_cached_blocks: Optional[int] = None) -> None:
         self.block_size = block_size
         self.prefetch = prefetch
-        self.max_cached_blocks = max_cached_blocks
+        # None ⇒ DisqOptions.http_cache_blocks override, then the
+        # DISQ_TPU_HTTP_CACHE_BLOCKS env knob, then 32 — operators size
+        # the LRU to the workload, and the scheduler's locality scorer
+        # reads occupancy off the fsw.http.cache.blocks gauge.
+        self.max_cached_blocks = (int(max_cached_blocks)
+                                  if max_cached_blocks is not None
+                                  else _default_cache_blocks())
         self.stats = _Stats()
         # Canonical thread naming: the sampling profiler
         # (runtime/profiler.py) and py-spy both attribute samples by
@@ -95,11 +145,42 @@ class HttpFileSystemWrapper(FileSystemWrapper):
         self._cache: "OrderedDict[Tuple[str, int], object]" = OrderedDict()
         self._lengths: dict = {}
 
+    def set_max_cached_blocks(self, n: int) -> None:
+        """Resize the block LRU; shrinking trims completed blocks from
+        the LRU head immediately (in-flight prefetches are never
+        dropped, exactly like steady-state eviction)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"http cache capacity must be >= 1, got {n}")
+        with self._lock:
+            self.max_cached_blocks = n
+            for old_key in list(self._cache):
+                if len(self._cache) <= n:
+                    break
+                old = self._cache[old_key]
+                if isinstance(old, Future) and not old.done():
+                    continue
+                self._cache.pop(old_key)
+                self.stats.cache_evictions += 1
+                _counter("fsw.http.cache.evictions").inc()
+            _observe_gauge("fsw.http.cache.blocks", len(self._cache))
+
+    def cached_block_indices(self, path: str) -> List[int]:
+        """The completed block indices this cache holds for ``path`` —
+        the occupancy a scheduler worker reports in its lease request
+        so shards land on the host whose cache already covers their
+        byte range (``runtime/scheduler.py`` locality scoring)."""
+        url = rewrite_remote_uri(path)
+        with self._lock:
+            return sorted(idx for (u, idx), v in self._cache.items()
+                          if u == url and isinstance(v, bytes))
+
     def _cache_put(self, key, value) -> None:
         # caller holds self._lock
         self._cache[key] = value
         self._cache.move_to_end(key)
         if len(self._cache) <= self.max_cached_blocks:
+            _observe_gauge("fsw.http.cache.blocks", len(self._cache))
             return
         # Evict from the LRU head, *skipping* (never dropping) in-flight
         # prefetches: an in-flight Future at the head must not shield
@@ -120,6 +201,7 @@ class HttpFileSystemWrapper(FileSystemWrapper):
             self._cache.pop(old_key)
             self.stats.cache_evictions += 1
             _counter("fsw.http.cache.evictions").inc()
+        _observe_gauge("fsw.http.cache.blocks", len(self._cache))
 
     # -- plumbing ----------------------------------------------------------
 

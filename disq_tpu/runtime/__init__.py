@@ -128,6 +128,13 @@ from disq_tpu.runtime.multihost import (  # noqa: F401
     process_count,
     process_id,
 )
+from disq_tpu.runtime.scheduler import (  # noqa: F401
+    SchedulerClient,
+    ShardCoordinator,
+    client_for_storage,
+    scheduled_map_ordered,
+    serve_coordinator,
+)
 from disq_tpu.runtime.introspect import (  # noqa: F401
     HEALTH,
     PipelineHealth,

@@ -199,6 +199,23 @@ class DisqOptions:
     # ⇒ canonical host zlib and zero device allocations
     # (check_overhead-guarded).
     device_deflate: bool = False
+    # Cross-host shard scheduler (runtime/scheduler.py): None (default)
+    # keeps the static split loops with zero coordinator threads or
+    # sockets; "serve" hosts the coordinator on this process's
+    # introspection endpoint and works; "host:port" joins that
+    # coordinator as a worker. sched_lease_n shards per lease round,
+    # sched_lease_s lease expiry (crash-detection latency), sched_steal
+    # arms idle-worker stealing. Env equivalents: DISQ_TPU_SCHED,
+    # DISQ_TPU_SCHED_LEASE_N/_LEASE_S/_STEAL (env wins for the tuning
+    # knobs so subprocess workers inherit their launcher's settings).
+    scheduler: Optional[str] = None
+    sched_lease_n: int = 2
+    sched_lease_s: float = 10.0
+    sched_steal: bool = True
+    # HTTP block-LRU capacity (fsw/http.py) — None keeps the built-in
+    # default (32 blocks, or DISQ_TPU_HTTP_CACHE_BLOCKS); the locality
+    # scorer reads occupancy off the fsw.http.cache.blocks gauge.
+    http_cache_blocks: Optional[int] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -274,6 +291,26 @@ class DisqOptions:
         if hz <= 0:
             raise ValueError(f"profile_hz must be > 0, got {hz}")
         return replace(self, profile_hz=float(hz))
+
+    def with_scheduler(self, mode: str, lease_n: int = 2,
+                       lease_s: float = 10.0,
+                       steal: bool = True) -> "DisqOptions":
+        if not mode:
+            raise ValueError(
+                "scheduler mode must be 'serve' or 'host:port'")
+        if lease_n < 1:
+            raise ValueError(f"sched_lease_n must be >= 1, got {lease_n}")
+        if lease_s <= 0:
+            raise ValueError(f"sched_lease_s must be > 0, got {lease_s}")
+        return replace(self, scheduler=str(mode),
+                       sched_lease_n=int(lease_n),
+                       sched_lease_s=float(lease_s),
+                       sched_steal=bool(steal))
+
+    def with_http_cache_blocks(self, n: int) -> "DisqOptions":
+        if n < 1:
+            raise ValueError(f"http_cache_blocks must be >= 1, got {n}")
+        return replace(self, http_cache_blocks=int(n))
 
     def with_resident_decode(self, enable: bool = True) -> "DisqOptions":
         return replace(self, resident_decode=bool(enable))

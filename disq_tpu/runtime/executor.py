@@ -95,7 +95,12 @@ class ShardTask:
     produces the shard's stand-in value — typically an empty batch,
     booked through the shard's quarantine machinery — when the shard's
     deadline expires; without it a ``DeadlineExceededError`` aborts the
-    run (the strict-policy behavior)."""
+    run (the strict-policy behavior).
+
+    ``byte_range`` is the shard's compressed byte window ``(lo, hi)``
+    in the input file — the coordinate the cross-host scheduler's
+    locality scorer matches against a worker's HTTP block-cache
+    occupancy (``runtime/scheduler.py``; None ⇒ never locality-routed)."""
 
     shard_id: int
     fetch: Callable[[], Any]
@@ -103,6 +108,7 @@ class ShardTask:
     retrier: Optional[ShardRetrier] = None
     what: str = "shard"
     deadline_fallback: Optional[Callable[[], Any]] = None
+    byte_range: Optional[tuple] = None
 
 
 @dataclass
@@ -609,6 +615,11 @@ def executor_for_storage(storage) -> ShardPipelineExecutor:
     opts = getattr(storage, "_options", None) or DisqOptions()
     flightrec.configure_from_options(opts)
     profiler.configure_from_options(opts)
+    cache_blocks = getattr(opts, "http_cache_blocks", None)
+    if cache_blocks:
+        from disq_tpu.fsw.http import configure_cache_blocks
+
+        configure_cache_blocks(cache_blocks)
     return ShardPipelineExecutor(
         workers=getattr(opts, "executor_workers", 1),
         prefetch_shards=getattr(opts, "prefetch_shards", None),

@@ -51,6 +51,7 @@ import os
 import sys
 import threading
 import time
+import urllib.parse
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -610,6 +611,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "total_in_ring": len(ring),
                 "spans": ring[-n:],
             })
+        elif path.startswith("/sched/"):
+            # Cross-host shard scheduler plane (runtime/scheduler.py):
+            # resolved only when a /sched/* request actually arrives,
+            # so the scheduler-off path never imports or allocates
+            # anything here.
+            from disq_tpu.runtime import scheduler
+
+            doc: Dict[str, Any] = {}
+            for part in query.split("&"):
+                if part.startswith("run="):
+                    doc["run"] = urllib.parse.unquote(part[len("run="):])
+            code, body = scheduler.handle_http("GET", path, doc)
+            self._send_json(body, code)
         elif path == "/debug/stacks":
             self._send(200, flightrec.thread_stacks_text().encode(),
                        "text/plain; charset=utf-8")
@@ -629,8 +643,30 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json({"error": "unknown path", "endpoints": [
                 "/metrics", "/healthz", "/progress", "/spans",
-                "/debug/stacks", "/debug/profile", "/debug/bundle"]},
+                "/debug/stacks", "/debug/profile", "/debug/bundle",
+                "/sched/stats"]},
                 404)
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        """The scheduler plane's mutating endpoints
+        (``/sched/join|lease|done|steal`` — runtime/scheduler.py).
+        Everything else is GET-only."""
+        path, _, _query = self.path.partition("?")
+        if not path.startswith("/sched/"):
+            self._send_json({"error": "POST only serves /sched/*"}, 404)
+            return
+        from disq_tpu.runtime import scheduler
+
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length)) if length else {}
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, OSError) as e:
+            self._send_json({"error": f"bad request body: {e}"}, 400)
+            return
+        code, body = scheduler.handle_http("POST", path, doc)
+        self._send_json(body, code)
 
     def _serve_profile(self, query: str) -> None:
         """``/debug/profile?seconds=N&hz=M[&format=speedscope]``:

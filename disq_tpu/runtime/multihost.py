@@ -49,11 +49,16 @@ def process_id() -> int:
 
     Resolution order: ``DISQ_TPU_PROCESS_ID`` (explicit override —
     also how CPU-only subprocess tests and non-jax launchers assign
-    distinct ids), then ``jax.process_index()``, then 0."""
+    distinct ids; negative values are rejected and fall through, the
+    way ``process_count`` clamps to ≥ 1 — a negative id would corrupt
+    cluster labeling and the aggregator's unique-id fallback), then
+    ``jax.process_index()``, then 0."""
     raw = os.environ.get("DISQ_TPU_PROCESS_ID")
     if raw is not None and raw != "":
         try:
-            return int(raw)
+            value = int(raw)
+            if value >= 0:
+                return value
         except ValueError:
             pass
     try:
@@ -110,13 +115,23 @@ def global_mesh(dcn_axis: str = "dcn", ici_axis: str = "shards"):
     n_proc = jax.process_count()
     dcn, per_host = plan_axes(len(devs), n_proc)
     arr = np.empty((dcn, per_host), dtype=object)
-    for d in devs:
+    for d, ordinal in _local_ordinals(devs).items():
         # jax orders devices by (process_index, local ordinal); place
         # explicitly so the DCN axis is exactly the host boundary
-        arr[d.process_index, _local_ordinal(d, devs)] = d
+        arr[d.process_index, ordinal] = d
     return Mesh(arr, (dcn_axis, ici_axis))
 
 
-def _local_ordinal(dev, devs) -> int:
-    same = [d for d in devs if d.process_index == dev.process_index]
-    return sorted(same, key=lambda d: d.id).index(dev)
+def _local_ordinals(devs) -> dict:
+    """``{device: local ordinal}`` for every device, computed in ONE
+    pass — one sort per process group instead of the old per-device
+    re-sort (O(n²·log n) across a large mesh, where n is the global
+    device count)."""
+    by_proc: dict = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    ordinals: dict = {}
+    for same in by_proc.values():
+        for i, d in enumerate(sorted(same, key=lambda d: d.id)):
+            ordinals[d] = i
+    return ordinals

@@ -1,0 +1,738 @@
+"""Cross-host shard scheduler — the distributed data plane.
+
+``runtime/multihost.py`` plans meshes and ``runtime/cluster.py``
+aggregates metrics, but until this module a multi-process run was N
+*static* partitions: every host decoded ``shards[i::N]`` and the job's
+wall clock was the slowest host (ROADMAP item 3).  This is the native
+replacement for the retained "Spark driver" scheduling role: one
+process (the coordinator) serves a shared shard work-queue over the
+existing introspection HTTP plane, and every process — coordinator
+included — *leases* shards in small batches instead of receiving a
+fixed split.  The GATK-on-Spark cluster study (PAPERS.md 1806.00788)
+shows partition skew and straggler executors gate scaling; the
+BWT-on-Spark work (PAPERS.md 2107.03341) shows dynamic redistribution
+recovers it.  Three mechanisms ride on the queue:
+
+- **Locality-aware assignment.**  A worker's lease request carries its
+  HTTP block-cache occupancy (``fsw/http.py`` LRU keys).  The
+  coordinator routes a shard to the host whose cache already holds
+  blocks of its byte range (``sched.locality{result=hit}``), falling
+  back to plain FIFO order (``miss``) — a warm cache never re-downloads
+  a range another host would fetch cold.
+- **Work stealing.**  An idle worker (empty lease) calls
+  ``/sched/steal``: the coordinator reassigns the *oldest* lease held
+  past ``steal_after_s`` by the most-loaded other host — the cross-host
+  complement of the in-process hedged fetches (``runtime/resilience``).
+  First ``/sched/done`` wins; the loser's completion books
+  ``sched.dup_done`` and its result is dropped by the losing worker, so
+  exactly one host emits each shard.
+- **Elastic membership + crash handoff.**  Processes ``/sched/join``
+  (and may disappear) mid-run; membership changes are booked as flight
+  recorder events.  A lease not completed within ``lease_s`` expires
+  back into the queue (``sched.lease_expired``), so a SIGKILLed host's
+  unfinished shards are re-leased to survivors.  With a *shared*
+  ``ReadLedger`` (``DisqOptions.read_ledger`` on a common directory)
+  the dead host's already-completed shards stay completed — their
+  decoded spills survive in the ledger — and a successor leasing a
+  spilled shard serves it from the ledger instead of re-decoding, so
+  handoff never re-does finished work.
+
+Zero overhead when disabled (the default): ``client_for_storage``
+returns ``None``, ``scheduled_map_ordered`` falls straight through to
+``map_ordered_resumable``, and no coordinator object, thread or socket
+exists (``scripts/check_overhead.py`` guards this structurally).
+
+Knobs (``DisqOptions`` fields / env — env wins for the ``sched_*``
+tuning knobs so subprocess workers are configured by their launcher):
+
+- ``scheduler`` / ``DISQ_TPU_SCHED``: ``None`` off (default);
+  ``"serve"`` host the coordinator in-process (on the introspection
+  endpoint) and work; ``"host:port"`` join that coordinator.
+- ``sched_lease_n`` / ``DISQ_TPU_SCHED_LEASE_N``: shards per lease
+  round (default 2 — small batches are what makes stealing effective).
+- ``sched_lease_s`` / ``DISQ_TPU_SCHED_LEASE_S``: lease expiry seconds
+  (default 10; the crash-detection latency).
+- ``sched_steal`` / ``DISQ_TPU_SCHED_STEAL``: enable stealing
+  (default on).
+- ``DISQ_TPU_SCHED_HOST``: host identity override (default
+  ``p<process_id()>``).
+- ``DISQ_TPU_SCHED_STATIC=k,N``: bench/compare mode — lease only
+  shards ``≡ k (mod N)`` and exit when that class drains: a *static*
+  split expressed through the same machinery, so scheduler-vs-static
+  comparisons pay identical RPC overhead.
+- ``DISQ_TPU_SCHED_SALT``: appended to the run key so repeated reads
+  of the same input register as distinct runs (bench reps).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from disq_tpu.runtime import flightrec
+from disq_tpu.runtime.tracing import (
+    REGISTRY,
+    counter,
+    observe_gauge,
+    record_span,
+    span,
+)
+
+DEFAULT_LEASE_N = 2
+DEFAULT_LEASE_S = 10.0
+_IDLE_SLEEP_MIN_S = 0.02
+_IDLE_SLEEP_MAX_S = 0.25
+_RPC_RETRIES = 3
+_RPC_BACKOFF_S = 0.05
+
+
+# ---------------------------------------------------------------------------
+# Coordinator
+# ---------------------------------------------------------------------------
+
+
+class _Run:
+    """One registered read's queue state on the coordinator."""
+
+    def __init__(self, key: str, path: str,
+                 ranges: Dict[int, Optional[Tuple[int, int]]]) -> None:
+        self.key = key
+        self.path = path
+        self.ranges = ranges
+        self.joined: set = set()  # hosts that joined THIS pass
+        self.epoch = 1            # pass number for this run key
+        self.pending: List[int] = sorted(ranges)   # ascending shard ids
+        self.leases: Dict[int, Tuple[str, float]] = {}  # shard -> (host, since)
+        self.done: Dict[int, str] = {}             # shard -> winning host
+        self.requeued: List[int] = []              # expiry-reclaimed shards
+        self.stolen: List[int] = []                # steal-reassigned shards
+        self.locality_hits = 0
+        self.locality_misses = 0
+
+    @property
+    def finished(self) -> bool:
+        return len(self.done) == len(self.ranges)
+
+
+class ShardCoordinator:
+    """The shared work-queue process 0 serves (see module docstring).
+
+    All state mutations run under one lock; expiry sweeps piggyback on
+    every request (no dedicated thread — the scheduler-off path must
+    stay thread-free, and the scheduler-on path is request-driven
+    anyway).  ``clock`` is injectable for deterministic expiry tests.
+    """
+
+    def __init__(self, lease_s: float = DEFAULT_LEASE_S,
+                 steal_after_s: Optional[float] = None,
+                 clock=time.monotonic) -> None:
+        self.lease_s = float(lease_s)
+        self.steal_after_s = (float(steal_after_s) if steal_after_s
+                              is not None else self.lease_s / 3.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._runs: Dict[str, _Run] = {}
+        self._hosts: Dict[str, float] = {}  # host -> last_seen
+        self._epochs: Dict[str, int] = {}   # run key -> last pass number
+
+    # -- sweeps -------------------------------------------------------------
+
+    def _sweep_locked(self, now: float) -> None:
+        """Reclaim expired leases and drop silent members.  Caller
+        holds the lock."""
+        expired: List[Tuple[str, str, int]] = []
+        for run in self._runs.values():
+            for shard, (host, since) in list(run.leases.items()):
+                if now - since >= self.lease_s:
+                    del run.leases[shard]
+                    bisect.insort(run.pending, shard)
+                    run.requeued.append(shard)
+                    expired.append((run.key, host, shard))
+        for host, seen in list(self._hosts.items()):
+            if now - seen < 2.0 * self.lease_s:
+                continue
+            if any(host == h for run in self._runs.values()
+                   for h, _s in run.leases.values()):
+                continue
+            del self._hosts[host]
+            flightrec.record_event("sched_member_lost", host=host)
+        if expired:
+            observe_gauge("sched.members", len(self._hosts))
+        for key, host, shard in expired:
+            counter("sched.lease_expired").inc()
+            flightrec.record_event("sched_lease_expired", host=host,
+                                   shard=shard, run=key)
+
+    # -- requests -----------------------------------------------------------
+
+    def join(self, host: str, run: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, Any]:
+        """Register ``host`` (idempotent) and, when ``run`` carries a
+        shard table, register the run (first registration wins; all
+        workers compute the identical table from the same input)."""
+        now = self._clock()
+        with self._lock:
+            fresh = host not in self._hosts
+            self._hosts[host] = now
+            registered = False
+            if run and run.get("key"):
+                key = str(run["key"])
+                existing = self._runs.get(key)
+                if (existing is not None and existing.finished
+                        and host in existing.joined):
+                    # A host that participated in the (now finished)
+                    # pass is registering the same input again: that is
+                    # a NEW read, not a late same-pass joiner — start a
+                    # fresh pass.  A host joining a finished run it
+                    # never participated in arrived after the work was
+                    # done and correctly emits nothing.
+                    del self._runs[key]
+                    existing = None
+                if existing is None:
+                    ranges = {
+                        int(sid): (tuple(rng) if rng else None)
+                        for sid, rng in (run.get("shards") or {}).items()
+                    }
+                    fresh_run = _Run(key, str(run.get("path", "")),
+                                     ranges)
+                    fresh_run.epoch = self._epochs.get(key, 0) + 1
+                    self._epochs[key] = fresh_run.epoch
+                    self._runs[key] = fresh_run
+                    registered = True
+                self._runs[key].joined.add(host)
+                epoch = self._runs[key].epoch
+            else:
+                epoch = None
+            members = len(self._hosts)
+        observe_gauge("sched.members", members)
+        if fresh:
+            flightrec.record_event("sched_join", host=host)
+        return {"host": host, "registered": registered, "members": members,
+                "epoch": epoch}
+
+    @staticmethod
+    def _locality_score(rng: Optional[Tuple[int, int]],
+                        blocks: frozenset, block_size: int) -> int:
+        if rng is None or not blocks or block_size <= 0:
+            return 0
+        lo, hi = rng
+        if hi <= lo:
+            return 0
+        first, last = lo // block_size, (hi - 1) // block_size
+        # walk whichever side is smaller: shard spans are a few blocks
+        # wide normally, but a tiny reported block_size must not turn
+        # one score into a giant range scan
+        if last - first + 1 > len(blocks):
+            return sum(1 for b in blocks if first <= b <= last)
+        return sum(1 for b in range(first, last + 1) if b in blocks)
+
+    def lease(self, host: str, key: str, want: int = DEFAULT_LEASE_N,
+              block_size: Optional[int] = None,
+              blocks: Optional[Sequence[int]] = None,
+              static_of: Optional[Tuple[int, int]] = None,
+              epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Hand ``host`` up to ``want`` pending shards: locality-scored
+        picks first (shards whose byte range overlaps the host's cached
+        blocks), then FIFO ascending.  ``static_of=(k, N)`` restricts
+        eligibility to ``shard % N == k`` — the static-split compare
+        mode."""
+        now = self._clock()
+        want = max(1, int(want))
+        cached = frozenset(int(b) for b in blocks) if blocks else frozenset()
+        with self._lock:
+            self._hosts[host] = now
+            self._sweep_locked(now)
+            run = self._runs.get(key)
+            if run is None:
+                return {"error": f"unknown run {key!r}", "shards": []}
+            if epoch is not None and epoch != run.epoch:
+                # the caller belongs to a previous pass of this key —
+                # its pass is over; it must not drain the new pass
+                return {"shards": [], "finished": True, "stale": True}
+            eligible = [s for s in run.pending
+                        if static_of is None
+                        or s % static_of[1] == static_of[0]]
+            picked: List[int] = []
+            hits = 0
+            if cached and block_size:
+                scored = sorted(
+                    ((self._locality_score(run.ranges.get(s), cached,
+                                           int(block_size)), s)
+                     for s in eligible),
+                    key=lambda t: (-t[0], t[1]))
+                for score, s in scored:
+                    if len(picked) >= want or score <= 0:
+                        break
+                    picked.append(s)
+                    hits += 1
+            for s in eligible:
+                if len(picked) >= want:
+                    break
+                if s not in picked:
+                    picked.append(s)
+            for s in picked:
+                run.pending.remove(s)
+                run.leases[s] = (host, now)
+            run.locality_hits += hits
+            run.locality_misses += len(picked) - hits
+            pending_n = len(run.pending)
+            outstanding = len(run.leases)
+            finished = run.finished
+        if picked:
+            counter("sched.leases").inc(len(picked), host=host)
+            if hits:
+                counter("sched.locality").inc(hits, result="hit")
+            if len(picked) - hits:
+                counter("sched.locality").inc(len(picked) - hits,
+                                              result="miss")
+        observe_gauge("sched.queue_depth", pending_n)
+        return {"shards": sorted(picked), "pending": pending_n,
+                "outstanding": outstanding, "finished": finished}
+
+    def done(self, host: str, key: str, shard: int,
+             epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Mark one shard complete.  First completion wins; a losing
+        (stolen-race) completion returns ``won=False`` so the worker
+        drops its duplicate result."""
+        now = self._clock()
+        shard = int(shard)
+        with self._lock:
+            self._hosts[host] = now
+            run = self._runs.get(key)
+            if run is None:
+                return {"error": f"unknown run {key!r}", "won": False}
+            if epoch is not None and epoch != run.epoch:
+                # a straggler completion from a previous pass: that
+                # pass already finished (reset requires finished), so
+                # every shard it could report was already won there
+                return {"won": False, "finished": True, "stale": True}
+            newly = shard not in run.done
+            if newly:
+                run.done[shard] = host
+                run.leases.pop(shard, None)
+                # a late completion of a shard that expired back into
+                # the queue still wins — retract the duplicate work
+                if shard in run.pending:
+                    run.pending.remove(shard)
+            # Idempotent for the WINNER: the client retries a done POST
+            # whose response was lost — telling the true winner
+            # won=False would make it drop the only copy of the shard's
+            # result.  Only a DIFFERENT host's completion is a lost
+            # race.
+            won = run.done[shard] == host
+            by_host = sum(1 for h in run.done.values() if h == host)
+            finished = run.finished
+        if newly:
+            REGISTRY.gauge("sched.shards").observe(by_host, host=host)
+        elif not won:
+            counter("sched.dup_done").inc()
+        return {"won": won, "finished": finished}
+
+    def steal(self, host: str, key: str,
+              epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Reassign to ``host`` the oldest lease held past
+        ``steal_after_s`` by the most-loaded *other* host — the
+        idle-worker path when the queue is dry but stragglers still
+        hold work."""
+        now = self._clock()
+        with self._lock:
+            self._hosts[host] = now
+            self._sweep_locked(now)
+            run = self._runs.get(key)
+            if run is None:
+                return {"error": f"unknown run {key!r}", "shards": []}
+            if epoch is not None and epoch != run.epoch:
+                return {"shards": [], "finished": True, "stale": True}
+            stale: Dict[str, List[Tuple[float, int]]] = {}
+            for shard, (holder, since) in run.leases.items():
+                if holder != host and now - since >= self.steal_after_s:
+                    stale.setdefault(holder, []).append((since, shard))
+            victim = max(stale, key=lambda h: len(stale[h])) if stale else None
+            if victim is None:
+                return {"shards": [], "pending": len(run.pending),
+                        "outstanding": len(run.leases),
+                        "finished": run.finished}
+            _since, shard = min(stale[victim])
+            run.leases[shard] = (host, now)
+            run.stolen.append(shard)
+            finished = run.finished
+        counter("sched.steals").inc(victim=victim)
+        flightrec.record_event("sched_steal", thief=host, victim=victim,
+                               shard=shard, run=key)
+        return {"shards": [shard], "victim": victim, "finished": finished}
+
+    def stats(self, key: Optional[str] = None) -> Dict[str, Any]:
+        """Observability snapshot (``GET /sched/stats``) — what the
+        bench and the handoff tests assert on."""
+        with self._lock:
+            self._sweep_locked(self._clock())
+            runs = {}
+            for k, run in self._runs.items():
+                if key is not None and k != key:
+                    continue
+                total_local = run.locality_hits + run.locality_misses
+                runs[k] = {
+                    "path": run.path,
+                    "epoch": run.epoch,
+                    "shards": len(run.ranges),
+                    "pending": list(run.pending),
+                    "leases": {str(s): {"host": h, "age_s": round(
+                        self._clock() - since, 3)}
+                        for s, (h, since) in run.leases.items()},
+                    "done": {str(s): h for s, h in run.done.items()},
+                    "requeued": list(run.requeued),
+                    "stolen": list(run.stolen),
+                    "locality_hits": run.locality_hits,
+                    "locality_misses": run.locality_misses,
+                    "locality_hit_rate": round(
+                        run.locality_hits / total_local, 3)
+                    if total_local else 0.0,
+                    "finished": run.finished,
+                }
+            return {"members": sorted(self._hosts), "runs": runs}
+
+
+# ---------------------------------------------------------------------------
+# Module coordinator lifecycle + HTTP dispatch (runtime/introspect.py
+# routes /sched/* here only when a request arrives — zero overhead off)
+# ---------------------------------------------------------------------------
+
+_COORD_LOCK = threading.Lock()
+_COORDINATOR: Optional[ShardCoordinator] = None
+
+
+def active_coordinator() -> Optional[ShardCoordinator]:
+    return _COORDINATOR
+
+
+def serve_coordinator(lease_s: float = DEFAULT_LEASE_S,
+                      steal_after_s: Optional[float] = None,
+                      port: int = 0) -> str:
+    """Host the coordinator in this process on the introspection
+    endpoint (started if needed); idempotent.  Returns ``host:port``."""
+    global _COORDINATOR
+    from disq_tpu.runtime.introspect import start_introspect_server
+
+    with _COORD_LOCK:
+        if _COORDINATOR is None:
+            _COORDINATOR = ShardCoordinator(lease_s, steal_after_s)
+    return start_introspect_server(port)
+
+
+def stop_coordinator() -> None:
+    """Test hook: forget the coordinator (the introspection server, if
+    any, keeps running — ``reset_introspection`` owns that)."""
+    global _COORDINATOR
+    with _COORD_LOCK:
+        _COORDINATOR = None
+
+
+def handle_http(method: str, path: str,
+                doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    """Dispatch one ``/sched/*`` request from the introspection server.
+    Returns ``(status, json_doc)``."""
+    coord = _COORDINATOR
+    if coord is None:
+        return 409, {"error": "no scheduler coordinator in this process "
+                              "(DisqOptions.scheduler='serve' arms one)"}
+    op = path[len("/sched/"):]
+    try:
+        if op == "stats" and method == "GET":
+            return 200, coord.stats(doc.get("run"))
+        host = str(doc.get("host", ""))
+        if not host:
+            return 400, {"error": "missing host"}
+        if op == "join":
+            return 200, coord.join(host, doc.get("run"))
+        epoch = doc.get("epoch")
+        if epoch is not None:
+            epoch = int(epoch)
+        if op == "lease":
+            static_of = doc.get("static_of")
+            return 200, coord.lease(
+                host, str(doc.get("run", "")),
+                want=int(doc.get("want", DEFAULT_LEASE_N)),
+                block_size=doc.get("block_size"),
+                blocks=doc.get("blocks"),
+                static_of=(tuple(int(x) for x in static_of)
+                           if static_of else None),
+                epoch=epoch)
+        if op == "done":
+            return 200, coord.done(host, str(doc.get("run", "")),
+                                   int(doc["shard"]), epoch=epoch)
+        if op == "steal":
+            return 200, coord.steal(host, str(doc.get("run", "")),
+                                    epoch=epoch)
+    except (KeyError, TypeError, ValueError) as e:
+        return 400, {"error": f"bad request: {type(e).__name__}: {e}"}
+    return 404, {"error": f"unknown scheduler path {path!r}",
+                 "endpoints": ["/sched/join", "/sched/lease",
+                               "/sched/done", "/sched/steal",
+                               "/sched/stats"]}
+
+
+# ---------------------------------------------------------------------------
+# Worker client
+# ---------------------------------------------------------------------------
+
+
+class SchedulerClient:
+    """Worker-side JSON-over-HTTP client for the coordinator plane."""
+
+    def __init__(self, address: str, host: str,
+                 lease_n: int = DEFAULT_LEASE_N, steal: bool = True,
+                 static_of: Optional[Tuple[int, int]] = None,
+                 serves: bool = False, timeout_s: float = 10.0) -> None:
+        self.address = address
+        self.host = host
+        self.lease_n = max(1, int(lease_n))
+        self.steal = bool(steal)
+        self.static_of = static_of
+        self.serves = serves  # this process hosts the coordinator
+        self.timeout_s = timeout_s
+        self.run_key: Optional[str] = None
+        self.epoch: Optional[int] = None  # pass number, set by join()
+
+    def _call(self, op: str, doc: Dict[str, Any]) -> Dict[str, Any]:
+        url = f"http://{self.address}/sched/{op}"
+        body = json.dumps(doc).encode()
+        last: Optional[Exception] = None
+        with span("sched.rpc", op=op):
+            for attempt in range(_RPC_RETRIES):
+                try:
+                    req = urllib.request.Request(
+                        url, data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout_s) as resp:
+                        return json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    # coordinator answered: surface its error verbatim
+                    try:
+                        return json.loads(e.read())
+                    except ValueError:
+                        raise IOError(
+                            f"scheduler {op} failed: HTTP {e.code}") from e
+                except (urllib.error.URLError, OSError, ValueError) as e:
+                    last = e
+                    time.sleep(_RPC_BACKOFF_S * (attempt + 1))
+        raise IOError(
+            f"scheduler coordinator at {self.address} unreachable "
+            f"({op}): {last}") from last
+
+    def join(self, run_doc: Dict[str, Any]) -> Dict[str, Any]:
+        self.run_key = str(run_doc["key"])
+        resp = self._call("join", {"host": self.host, "run": run_doc})
+        self.epoch = resp.get("epoch")
+        return resp
+
+    def lease(self, cache: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {"host": self.host, "run": self.run_key,
+                               "want": self.lease_n, "epoch": self.epoch}
+        if cache:
+            doc.update(cache)
+        if self.static_of is not None:
+            doc["static_of"] = list(self.static_of)
+        return self._call("lease", doc)
+
+    def steal_once(self) -> Dict[str, Any]:
+        return self._call("steal", {"host": self.host,
+                                    "run": self.run_key,
+                                    "epoch": self.epoch})
+
+    def done(self, shard: int) -> Dict[str, Any]:
+        return self._call("done", {"host": self.host, "run": self.run_key,
+                                   "shard": int(shard),
+                                   "epoch": self.epoch})
+
+
+def _env_number(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        return default
+
+
+def client_for_storage(storage) -> Optional[SchedulerClient]:
+    """The scheduler client for one read, or None when the scheduler is
+    off (the default — ``scheduled_map_ordered`` then falls through to
+    the static path with zero extra work)."""
+    from disq_tpu.runtime.errors import DisqOptions
+    from disq_tpu.runtime.multihost import process_id
+
+    opts = getattr(storage, "_options", None) or DisqOptions()
+    mode = getattr(opts, "scheduler", None)
+    if mode is None:
+        mode = os.environ.get("DISQ_TPU_SCHED") or None
+    if not mode:
+        return None
+    lease_n = _env_number("DISQ_TPU_SCHED_LEASE_N",
+                          getattr(opts, "sched_lease_n", DEFAULT_LEASE_N),
+                          int)
+    lease_s = _env_number("DISQ_TPU_SCHED_LEASE_S",
+                          getattr(opts, "sched_lease_s", DEFAULT_LEASE_S),
+                          float)
+    steal = bool(_env_number("DISQ_TPU_SCHED_STEAL",
+                             1 if getattr(opts, "sched_steal", True) else 0,
+                             int))
+    static_raw = os.environ.get("DISQ_TPU_SCHED_STATIC")
+    static_of = None
+    if static_raw:
+        try:
+            k, n = static_raw.replace("/", ",").split(",")
+            static_of = (int(k), int(n))
+        except ValueError:
+            static_of = None
+    serves = mode in ("serve", "1", "coordinator")
+    if serves:
+        port = getattr(opts, "introspect_port", None)
+        address = serve_coordinator(lease_s=lease_s, port=port or 0)
+    else:
+        address = mode
+    host = os.environ.get("DISQ_TPU_SCHED_HOST") or f"p{process_id()}"
+    return SchedulerClient(address, host, lease_n=lease_n, steal=steal,
+                           static_of=static_of, serves=serves)
+
+
+# ---------------------------------------------------------------------------
+# The scheduled split loop — what the sources call instead of
+# map_ordered_resumable
+# ---------------------------------------------------------------------------
+
+
+def _cache_hints(fs, path: str) -> Optional[Dict[str, Any]]:
+    """The worker's locality hint: its HTTP block-cache occupancy for
+    ``path`` (None for non-HTTP filesystems — every pick then scores as
+    a locality miss, which is the truth)."""
+    from disq_tpu.fsw.http import HttpFileSystemWrapper
+
+    inner = fs
+    if hasattr(fs, "inner"):  # FaultInjectingFileSystemWrapper et al.
+        if hasattr(fs, "_strip"):
+            path = fs._strip(path)
+        inner = fs.inner
+    if not isinstance(inner, HttpFileSystemWrapper):
+        return None
+    return {"block_size": inner.block_size,
+            "blocks": inner.cached_block_indices(path)}
+
+
+def run_key_for(path: str, n_shards: int) -> str:
+    salt = os.environ.get("DISQ_TPU_SCHED_SALT", "")
+    return f"{path}#{n_shards}" + (f"#{salt}" if salt else "")
+
+
+def scheduled_map_ordered(storage, fs, path: str, executor, tasks,
+                          ledger=None) -> Iterator:
+    """``map_ordered_resumable`` behind the shard scheduler: with the
+    scheduler off (default) this IS ``map_ordered_resumable`` — same
+    iterator, same inline path.  With it on, this worker joins the
+    run's shared queue and yields exactly the shards it wins, in
+    ascending shard order per lease batch (a single-worker scheduled
+    run therefore emits the identical sequence the static path does).
+
+    Handoff contract: with a shared ``ledger`` every emitted shard is
+    spilled *before* its ``/sched/done``, so a shard completed by a
+    host that later dies never re-decodes — a successor leasing a
+    spilled shard serves it from the ledger."""
+    from disq_tpu.runtime.executor import map_ordered_resumable
+
+    client = client_for_storage(storage)
+    if client is None:
+        return map_ordered_resumable(executor, tasks, ledger)
+    return _scheduled_iter(client, storage, fs, path, executor,
+                           list(tasks), ledger)
+
+
+def _scheduled_iter(client: SchedulerClient, storage, fs, path: str,
+                    executor, tasks: List, ledger) -> Iterator:
+    from disq_tpu.runtime.executor import ShardResult, executor_for_storage
+
+    by_id = {t.shard_id: t for t in tasks}
+    run_doc = {
+        "key": run_key_for(path, len(tasks)),
+        "path": path,
+        "shards": {
+            str(t.shard_id): (list(t.byte_range)
+                              if getattr(t, "byte_range", None) else None)
+            for t in tasks
+        },
+    }
+    client.join(run_doc)
+    # The executor's resilience manager (hedge pool, deadlines) is
+    # single-use: its close() runs when one map_ordered exhausts.  The
+    # scheduled loop runs one map_ordered PER LEASE BATCH, so with
+    # resilience armed each batch gets a fresh executor; without it the
+    # one executor is reusable (pools are per-run, health tokens too).
+    single_use = getattr(executor, "_resilience", None) is not None
+    current = executor
+    idle = _IDLE_SLEEP_MIN_S
+    finished = False
+    while True:
+        resp = client.lease(_cache_hints(fs, path))
+        if resp.get("error"):
+            # coordinator restarted / forgot the run: fail the read
+            # loudly — spinning here would hang the caller forever
+            raise IOError(f"scheduler lease failed: {resp['error']}")
+        ids = list(resp.get("shards") or [])
+        if not ids and client.steal and client.static_of is None:
+            with span("sched.steal"):
+                sresp = client.steal_once()
+            if sresp.get("error"):
+                raise IOError(f"scheduler steal failed: {sresp['error']}")
+            ids = list(sresp.get("shards") or [])
+            if not ids and sresp.get("finished"):
+                finished = True
+        if not ids:
+            if resp.get("finished") or finished:
+                finished = True
+                break
+            if client.static_of is not None:
+                # static-compare mode: this host's residue class is
+                # drained — exit like a static split would
+                break
+            record_span("sched.wait", idle)
+            time.sleep(idle)
+            idle = min(_IDLE_SLEEP_MAX_S, idle * 1.7)
+            continue
+        idle = _IDLE_SLEEP_MIN_S
+        ids.sort()
+        cached = {i for i in ids
+                  if ledger is not None and ledger.is_done(i)}
+        if current is None:
+            current = executor_for_storage(storage)
+        fresh_iter = current.map_ordered(
+            [by_id[i] for i in ids if i not in cached])
+        for i in ids:
+            if i in cached:
+                res = ShardResult(i, ledger.load(i))
+            else:
+                res = next(fresh_iter)
+                if ledger is not None:
+                    # spill BEFORE done: once the coordinator believes
+                    # the shard complete its bytes must be recoverable
+                    ledger.record(res.shard_id, res.value)
+            if client.done(res.shard_id).get("won", True):
+                yield res
+            # a lost steal race: the thief already emitted this shard —
+            # drop the duplicate so exactly one host owns it
+        # exhaust the batch iterator so its close-out (resilience /
+        # stage-pool teardown) runs now, not at GC time
+        if next(fresh_iter, None) is not None:
+            raise RuntimeError("scheduled batch emitted extra shards")
+        if single_use:
+            current = None
+    if finished and client.serves and ledger is not None:
+        # the coordinator host observed completion: commit the shared
+        # ledger (spills dropped) exactly like the static path's finish
+        ledger.finish()

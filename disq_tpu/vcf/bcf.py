@@ -708,7 +708,11 @@ class BcfSource:
         # not be replaced by an empty stand-in (the stream would lose
         # framing): deadlines here keep the strict abort contract, but
         # hedging, the retry budget/breaker, and the crash-resume
-        # ledger all apply.
+        # ledger all apply.  The cross-host scheduler
+        # (runtime/scheduler.py) is deliberately NOT wired here: every
+        # process needs the full concatenated payload to parse the
+        # stream, so a leased subset of splits could never yield a
+        # per-host partition — BCF stays on the static split loop.
         ledger = read_ledger_for_storage(self._storage, path, len(tasks))
         for res in map_ordered_resumable(
                 executor_for_storage(self._storage), tasks, ledger):

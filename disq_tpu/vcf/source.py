@@ -90,7 +90,8 @@ class VcfSource:
                 functools.partial(lines_for_split, fs, path, s.start, s.end),
                 header, start=s.start, end=s.end,
             ))
-        return self._emit_batches(tasks, shard_ctxs, header, path=path)
+        return self._emit_batches(tasks, shard_ctxs, header, path=path,
+                                  fs=fs)
 
     def _make_task(self, shard_id, shard_ctx, fetch, header,
                    start=None, end=None):
@@ -120,21 +121,34 @@ class VcfSource:
             deadline_fallback=deadline_fallback_for(
                 opts, shard_ctx,
                 lambda: parse_vcf_lines([], header.contig_names)),
+            # Scheduler locality coordinate (byte window of the split).
+            byte_range=((start, end)
+                        if start is not None and end is not None else None),
         )
 
     def _emit_batches(self, tasks, shard_ctxs, header,
-                      path=None) -> VariantBatch:
+                      path=None, fs=None) -> VariantBatch:
         from disq_tpu.runtime.executor import (
             executor_for_storage,
             map_ordered_resumable,
             read_ledger_for_storage,
         )
+        from disq_tpu.runtime.scheduler import scheduled_map_ordered
 
         ledger = (read_ledger_for_storage(self._storage, path, len(tasks))
                   if path is not None else None)
         batches = []
-        for res in map_ordered_resumable(
-                executor_for_storage(self._storage), tasks, ledger):
+        if path is not None and fs is not None:
+            # scheduler off (default) falls straight through to
+            # map_ordered_resumable; on, this worker leases splits from
+            # the shared cross-host queue.
+            emitted = scheduled_map_ordered(
+                self._storage, fs, path,
+                executor_for_storage(self._storage), tasks, ledger)
+        else:
+            emitted = map_ordered_resumable(
+                executor_for_storage(self._storage), tasks, ledger)
+        for res in emitted:
             batches.append(res.value)
             self._track(shard_ctxs[res.shard_id], res.shard_id, res.value)
         return (VariantBatch.concat(batches) if batches
@@ -187,7 +201,8 @@ class VcfSource:
                                   s.start, s.end, length, ctx=shard_ctx),
                 header, start=s.start, end=s.end,
             ))
-        return self._emit_batches(tasks, shard_ctxs, header, path=path)
+        return self._emit_batches(tasks, shard_ctxs, header, path=path,
+                                  fs=fs)
 
     def _inflate_with_gaps(self, data, blocks, gaps, base: int, ctx):
         """``_inflate_with_policy`` when the block walk itself needed

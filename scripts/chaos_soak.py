@@ -534,6 +534,162 @@ def postmortem_check(tmp) -> str:
     return ""
 
 
+_STEAL_CHILD = r"""
+import hashlib, json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from disq_tpu import ReadsStorage
+from disq_tpu.bam.source import BamSource, read_header
+from disq_tpu.fsw import (FaultInjectingFileSystemWrapper, FaultSpec,
+                          PosixFileSystemWrapper, register_filesystem)
+from disq_tpu.fsw.filesystem import resolve_path
+
+# Worker 0 is the deliberate straggler: every read_range draws a
+# seeded latency from [0, slow_s) — the faultfs "slow" tail.
+faults = []
+if {slow_s} > 0:
+    faults = [FaultSpec(kind="slow", probability=1.0, slow_s={slow_s})]
+register_filesystem("fault", FaultInjectingFileSystemWrapper(
+    PosixFileSystemWrapper(), faults, seed=11))
+src = BamSource(ReadsStorage.make_default().split_size({split}))
+fs, p = resolve_path("fault://" + {path!r})
+header, fv = read_header(fs, p)
+t0 = time.perf_counter()
+batches = src.read_split_batches(fs, p, header, fv)
+wall = time.perf_counter() - t0
+digests = {{}}
+for c, b in zip(src._last_counters, batches):
+    h = hashlib.sha1()
+    for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+        h.update(np.ascontiguousarray(getattr(b, f)).tobytes())
+    digests[str(c.shard_id)] = h.hexdigest()
+print(json.dumps({{"host": os.environ.get("DISQ_TPU_SCHED_HOST"),
+                   "wall": round(wall, 3), "shards": digests}}))
+"""
+
+
+def steal_leg(path, tmp) -> str:
+    """--steal leg: a 2-worker scheduled read with one deliberately
+    slowed worker.  The coordinator (this process) must route the
+    drained queue's stale leases to the fast worker (``sched.steals``
+    ≥ 1), every shard must be emitted by exactly one worker, and the
+    union of the workers' per-shard digests must equal a fault-free
+    single-host read's."""
+    import hashlib
+    import json
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    from disq_tpu import ReadsStorage
+    from disq_tpu.bam.source import BamSource, read_header
+    from disq_tpu.fsw.filesystem import resolve_path
+    from disq_tpu.runtime import scheduler
+    from disq_tpu.runtime.introspect import reset_introspection
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Fault-free single-host truth: per-shard digest table.
+    src = BamSource(ReadsStorage.make_default().split_size(SPLIT))
+    fs, p = resolve_path(path)
+    header, fv = read_header(fs, p)
+    want = {}
+    batches = src.read_split_batches(fs, p, header, fv)
+    for c, b in zip(src._last_counters, batches):
+        h = hashlib.sha1()
+        for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+            h.update(np.ascontiguousarray(getattr(b, f)).tobytes())
+        want[str(c.shard_id)] = h.hexdigest()
+
+    addr = scheduler.serve_coordinator(lease_s=8.0, steal_after_s=0.1)
+    try:
+        return _steal_leg_body(addr, path, repo, want)
+    finally:
+        # every return path (failure included) must drop the
+        # coordinator — a stale unfinished "chaos-steal" run would
+        # poison later seeds' legs
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+
+def _steal_leg_body(addr, path, repo, want) -> str:
+    import json
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    from disq_tpu.runtime import scheduler
+
+    def spawn(i, slow_s):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "DISQ_TPU_SCHED": addr,
+               "DISQ_TPU_SCHED_HOST": f"h{i}",
+               "DISQ_TPU_SCHED_LEASE_N": "2",
+               "DISQ_TPU_SCHED_STEAL": "1",
+               "DISQ_TPU_SCHED_SALT": "chaos-steal"}
+        return subprocess.Popen(
+            [_sys.executable, "-c", _STEAL_CHILD.format(
+                repo=repo, path=path, split=SPLIT, slow_s=slow_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+
+    # The straggler starts first and must be seen HOLDING leases
+    # before the fast worker launches — otherwise interpreter
+    # startup skew lets the fast worker drain the queue before the
+    # slow one even joins, and there is nothing to steal.
+    # slow_s=0.6 per read keeps each of the straggler's shards in
+    # flight well past steal_after_s, so the fast worker's steal is a
+    # wide-open window, not a race
+    slow = spawn(0, 0.6)
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        if slow.poll() is not None:
+            return ("steal: slow worker exited before leasing: "
+                    + slow.communicate()[1][-500:])
+        stats = scheduler.active_coordinator().stats()
+        run = next((r for k, r in stats["runs"].items()
+                    if "chaos-steal" in k), None)
+        if run is not None and any(
+                lease["host"] == "h0"
+                for lease in run["leases"].values()):
+            break
+        _time.sleep(0.02)
+    else:
+        slow.kill()
+        return "steal: slow worker never leased a shard"
+    procs = [slow, spawn(1, 0.0)]
+    outs = []
+    for proc in procs:
+        out, err = proc.communicate(timeout=300)
+        if proc.returncode != 0:
+            return f"steal: worker failed: {err[-500:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    got = {}
+    for doc in outs:
+        for sid, dig in doc["shards"].items():
+            if sid in got:
+                return f"steal: shard {sid} emitted by two workers"
+            got[sid] = dig
+    if got != want:
+        missing = sorted(set(want) - set(got), key=int)
+        wrong = sorted((k for k in got if want.get(k) != got[k]), key=int)
+        return (f"steal: shard digests diverge (missing={missing}, "
+                f"wrong={wrong})")
+    stats = scheduler.active_coordinator().stats()
+    run = next((r for k, r in stats["runs"].items()
+                if "chaos-steal" in k), None)
+    if run is None:
+        return "steal: coordinator never registered the run"
+    if not run["finished"]:
+        return f"steal: run not finished: {run}"
+    if not run["stolen"]:
+        return ("steal: the fast worker never stole from the slowed "
+                f"one ({run})")
+    return ""
+
+
 _KILL_CHILD = r"""
 import os, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -706,6 +862,13 @@ def main(argv=None) -> int:
                          "re-read to records identical to the "
                          "fault-free host-path output (byte-validity, "
                          "not byte-identity)")
+    ap.add_argument("--steal", action="store_true",
+                    help="run the work-stealing leg: a 2-subprocess "
+                         "scheduled read with one worker slowed by a "
+                         "faultfs slow tail must steal at least one "
+                         "lease to the fast worker, emit every shard "
+                         "exactly once, and match a fault-free "
+                         "single-host read digest for digest")
     ap.add_argument("--kill", action="store_true",
                     help="run the crash-resume leg: SIGKILL a writer "
                          "subprocess mid-run, resume from its "
@@ -763,6 +926,11 @@ def main(argv=None) -> int:
             err = device_write_leg(path, baseline)
             print(f"[device-write] "
                   f"{'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.steal:
+            err = steal_leg(path, tmp)
+            print(f"[steal] {'ok' if not err else 'FAIL: ' + err}")
             if err:
                 failures.append((args.seed, err))
         if args.kill:

@@ -59,6 +59,12 @@ CONFIG_TOLERANCE = {
     # device deflate, service-coalesced) on a real chip at 3 reps —
     # the same device-queue wobble as config 10 plus filesystem noise.
     "11_device_write": 0.25,
+    # Config 12 spawns subprocess workers (interpreter start + jax
+    # import inside the timed window is unavoidable for a real
+    # multi-process measurement) with a seeded-random slow worker and
+    # OS-scheduler-dependent steal timing — the widest legitimate
+    # run-to-run wobble in the matrix.
+    "12_sched_steal": 0.40,
 }
 
 

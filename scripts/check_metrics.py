@@ -61,6 +61,10 @@ ALLOWED_PREFIXES = {
     # HBM-resident fused decode (runtime/columnar.py): ColumnarBatch
     # build/fetch/release spans and the resident-bytes gauge.
     "columnar",
+    # Cross-host shard scheduler (runtime/scheduler.py): queue depth,
+    # lease/steal/locality accounting, membership gauge, worker RPC
+    # spans.
+    "sched",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

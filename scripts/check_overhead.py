@@ -132,6 +132,40 @@ def main() -> int:
             "a host-path deflate spun up the device service — "
             "submit_deflate must only run behind both knobs")
 
+    # -- 1b3. shard scheduler: disabled ⇒ no coordinator, inline loop --------
+    from disq_tpu.runtime import scheduler
+    from disq_tpu.runtime.executor import map_ordered_resumable  # noqa: F401
+    from disq_tpu.runtime.scheduler import (
+        client_for_storage, scheduled_map_ordered)
+
+    if os.environ.get("DISQ_TPU_SCHED"):
+        errors.append(
+            "DISQ_TPU_SCHED leaked into the guard's env — the default "
+            "path must run the static split loops")
+    if client_for_storage(_Storage()) is not None:
+        errors.append(
+            "client_for_storage built a scheduler client with no knob "
+            "configured — sources would RPC on the default path")
+    if scheduler.active_coordinator() is not None:
+        errors.append(
+            "a shard coordinator exists with no scheduler knob set — "
+            "the scheduler-off path must allocate no queue state")
+    sched_gen = scheduled_map_ordered(
+        _Storage(), None, "overhead-guard", ShardPipelineExecutor(workers=1),
+        [ShardTask(shard_id=0, fetch=lambda: 0,
+                   decode=lambda payload: payload)])
+    if getattr(sched_gen, "gi_code", None) is None \
+            or sched_gen.gi_code.co_name != "_run_sequential":
+        errors.append(
+            "scheduled_map_ordered(scheduler off) did not return the "
+            "inline map_ordered generator — the default split loop "
+            "grew a wrapper")
+    list(sched_gen)
+    if any(t.name.startswith("disq-sched")
+           for t in threading.enumerate()):
+        errors.append(
+            "stray scheduler thread on the disabled path")
+
     # -- 1c. resident decode: disabled ⇒ no ColumnarBatch device builds ------
     from disq_tpu.runtime import columnar
 

@@ -104,6 +104,10 @@ CATEGORIES = (
     # (hedge.waste) both paint H — a hedge racing its primary is
     # visible as overlap on the shard's row.
     ("hedge", "H", ("hedge.",)),
+    # Cross-host scheduler (runtime/scheduler.py): worker RPC rounds,
+    # the idle wait between empty lease rounds, and steal attempts —
+    # the coordination cost of the distributed data plane.
+    ("sched", "L", ("sched.",)),
     ("emit_stall", "s", ("executor.emit.stall", "writer.emit.stall")),
     ("retry", "r", ("retry.",)),
     ("quarantine", "q", ("quarantine.",)),
@@ -353,7 +357,12 @@ WORK_PRIORITY = ("device", "transfer", "device_write", "columnar",
                  # service queue wait ranks last: it only wins instants
                  # where nothing is making progress — lanes parked in
                  # the batcher while the device sits idle
-                 "service_wait")
+                 "service_wait",
+                 # scheduler coordination ranks below all real work:
+                 # RPC rounds only win instants where no stage runs,
+                 # and steal/idle-wait time is by definition a worker
+                 # with nothing to do
+                 "sched", "steal")
 
 ADVICE = {
     "fetch": "I/O-bound range reads: raise executor_workers / "
@@ -397,6 +406,15 @@ ADVICE = {
                    "bytes stayed in HBM instead of crossing d2h — "
                    "keep consumers on the resident columns "
                    "(flagstat/sort/depth) to grow this number",
+    "sched": "scheduler RPC overhead dominates: raise sched_lease_n "
+             "so each lease round carries more shards, or shrink the "
+             "shard count (bigger split_size) — the queue is being "
+             "polled more than it is worked",
+    "steal": "work-stealing wait dominates: this host idled while "
+             "another held stale leases — lower sched_lease_n so "
+             "stragglers hold fewer shards at a time, lower "
+             "sched_lease_s so a dead host's leases requeue sooner, "
+             "or check the victim host named in sched.steals{victim=}",
 }
 
 
@@ -406,6 +424,12 @@ def bucket_of(name: str) -> Optional[str]:
     # a pipeline stage — controls.
     if name == "hedge.waste":
         return "hedge_wasted"
+    # Steal rounds and the idle wait between empty lease rounds get
+    # their own bucket: wall-clock a worker spent hungry — the signal
+    # the stealing knobs (not a pipeline stage) control.  Plain
+    # sched.rpc coordination stays in the "sched" bucket.
+    if name in ("sched.steal", "sched.wait"):
+        return "steal"
     cat = category_of(name)
     if cat is None:
         return None

@@ -290,7 +290,10 @@ def test_chaos_soak_smoke(executor_workers):
     after d2h against the host path), --device-write (resident encode
     + service-routed SIMD deflate under write faults, record-compared
     after re-read against the fault-free host path), and --kill
-    (SIGKILL a writer mid-run, ledger-asserted resume)."""
+    (SIGKILL a writer mid-run, ledger-asserted resume), and --steal
+    (2-subprocess scheduled read with one slowed worker: the fast
+    worker must steal a stale lease, every shard emits exactly once,
+    digests match a single-host read)."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
@@ -299,7 +302,7 @@ def test_chaos_soak_smoke(executor_workers):
          "--seed", "7", "--executor-workers", str(executor_workers),
          "--writer-workers", str(executor_workers),
          "--hedge", "--breaker", "--resident", "--device-write",
-         "--kill"]
+         "--steal", "--kill"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},

@@ -408,3 +408,58 @@ class TestCacheEviction:
             fs._cache_put(("u", 2), b"b")
         assert len(fs._cache) <= 2
         assert ("u", 0) not in fs._cache  # done Future evicted first (LRU)
+
+
+class TestCacheSizeKnob:
+    """Satellite: configurable block-LRU capacity + occupancy gauge +
+    the cached-block report the scheduler's locality scorer reads."""
+
+    def test_env_knob_sizes_new_wrappers(self, monkeypatch):
+        from disq_tpu.fsw import http as http_mod
+
+        monkeypatch.setattr(http_mod, "_configured_cache_blocks", None)
+        monkeypatch.setenv("DISQ_TPU_HTTP_CACHE_BLOCKS", "7")
+        assert HttpFileSystemWrapper().max_cached_blocks == 7
+        monkeypatch.setenv("DISQ_TPU_HTTP_CACHE_BLOCKS", "garbage")
+        assert HttpFileSystemWrapper().max_cached_blocks == 32
+        monkeypatch.delenv("DISQ_TPU_HTTP_CACHE_BLOCKS")
+        assert HttpFileSystemWrapper().max_cached_blocks == 32
+        assert HttpFileSystemWrapper(max_cached_blocks=3) \
+            .max_cached_blocks == 3
+
+    def test_options_plumbing_resizes_registered_wrappers(
+            self, monkeypatch, bam_url):
+        from disq_tpu.fsw import http as http_mod
+        from disq_tpu.fsw.filesystem import _SCHEME_REGISTRY
+        from disq_tpu.runtime.errors import DisqOptions
+        from disq_tpu.runtime.executor import executor_for_storage
+
+        url, raw = bam_url
+        fs = HttpFileSystemWrapper(block_size=1024, max_cached_blocks=64)
+        monkeypatch.setitem(_SCHEME_REGISTRY, "http", fs)
+        monkeypatch.setattr(http_mod, "_configured_cache_blocks", None)
+        fs.read_range(url, 0, 16 * 1024)  # fill > 4 blocks
+        assert len(fs._cache) > 4
+
+        class _Storage:
+            _options = DisqOptions().with_http_cache_blocks(4)
+
+        executor_for_storage(_Storage())
+        assert fs.max_cached_blocks == 4
+        assert len(fs._cache) <= 4 + 1  # in-flight prefetch may overhang
+        # and later-constructed wrappers inherit the configured size
+        assert HttpFileSystemWrapper().max_cached_blocks == 4
+        monkeypatch.setattr(http_mod, "_configured_cache_blocks", None)
+
+    def test_occupancy_gauge_and_block_indices(self, bam_url):
+        from disq_tpu.runtime.tracing import REGISTRY
+
+        url, raw = bam_url
+        fs = HttpFileSystemWrapper(block_size=1024, prefetch=False,
+                                   max_cached_blocks=8)
+        fs.read_range(url, 0, 2048)       # blocks 0, 1
+        fs.read_range(url, 5 * 1024, 10)  # block 5
+        assert fs.cached_block_indices(url) == [0, 1, 5]
+        assert fs.cached_block_indices(url + ".other") == []
+        state = REGISTRY.gauge("fsw.http.cache.blocks").state()
+        assert state is not None and state["last"] >= 3

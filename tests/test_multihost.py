@@ -70,6 +70,14 @@ class TestProcessIdentity:
         assert process_id() == 0
         assert process_count() == 1
 
+    def test_negative_env_rejected_like_count_clamps(self, monkeypatch):
+        # a negative process id would corrupt cluster labeling; it must
+        # fall through to the default the way process_count clamps >= 1
+        monkeypatch.setenv("DISQ_TPU_PROCESS_ID", "-3")
+        monkeypatch.setenv("DISQ_TPU_PROCESS_COUNT", "-2")
+        assert process_id() == 0
+        assert process_count() == 1
+
     def test_introspect_endpoint_labels_process_multiprocess_mode(
             self, tmp_path):
         """A worker launched with a distinct DISQ_TPU_PROCESS_ID (the
@@ -137,6 +145,28 @@ class TestGlobalMesh:
         row = list(arr[0])
         assert [d.id for d in row] == sorted(d.id for d in jax.devices())
         assert all(d.process_index == 0 for d in row)
+
+    def test_local_ordinals_one_pass_matches_per_device_sort(self):
+        """The O(n) ordinal map must equal the old per-device re-sort
+        semantics: within each process group, ordinals are the rank of
+        the device id."""
+        from disq_tpu.runtime.multihost import _local_ordinals
+
+        class Dev:
+            def __init__(self, pid, did):
+                self.process_index = pid
+                self.id = did
+
+            def __repr__(self):
+                return f"Dev({self.process_index},{self.id})"
+
+        devs = [Dev(1, 7), Dev(0, 5), Dev(1, 2), Dev(0, 9), Dev(0, 1)]
+        ords = _local_ordinals(devs)
+        # process 0 devices by id: 1 -> 0, 5 -> 1, 9 -> 2
+        assert ords[devs[4]] == 0 and ords[devs[1]] == 1 \
+            and ords[devs[3]] == 2
+        # process 1: 2 -> 0, 7 -> 1
+        assert ords[devs[2]] == 0 and ords[devs[0]] == 1
 
     def test_custom_axis_names(self):
         mesh = global_mesh(dcn_axis="hosts", ici_axis="local")

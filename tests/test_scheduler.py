@@ -1,0 +1,587 @@
+"""Cross-host shard scheduler (runtime/scheduler.py).
+
+Coordinator mechanics run against an injected clock (deterministic
+expiry/steal), the HTTP plane against a live ephemeral introspection
+endpoint, the scheduled read path against real BAM fixtures (single
+worker must be byte-identical to the static path), and the
+crash-handoff contract against a SIGKILLed subprocess worker: the
+coordinator must re-queue exactly its unfinished leases, the
+successor must serve the dead worker's completed shards from the
+shared ReadLedger (never re-decoding them), and the assembled output
+must be byte-identical to a single-host read.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from disq_tpu.runtime import scheduler
+from disq_tpu.runtime.scheduler import (
+    SchedulerClient,
+    ShardCoordinator,
+    _scheduled_iter,
+    client_for_storage,
+    scheduled_map_ordered,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def register_run(coord, host="A", n=6, key="k"):
+    return coord.join(host, {
+        "key": key, "path": "p",
+        "shards": {str(i): [i * 100, (i + 1) * 100] for i in range(n)},
+    })
+
+
+class TestCoordinator:
+    def test_lease_fifo_ascending_and_done(self):
+        c = ShardCoordinator(clock=FakeClock())
+        doc = register_run(c)
+        assert doc["registered"] and doc["members"] == 1
+        r1 = c.lease("A", "k", want=2)
+        assert r1["shards"] == [0, 1] and r1["pending"] == 4
+        r2 = c.lease("A", "k", want=10)
+        assert r2["shards"] == [2, 3, 4, 5] and r2["pending"] == 0
+        for s in range(6):
+            d = c.done("A", "k", s)
+            assert d["won"]
+        assert d["finished"]
+        assert c.lease("A", "k")["finished"]
+
+    def test_join_idempotent_second_registration_ignored(self):
+        c = ShardCoordinator(clock=FakeClock())
+        assert register_run(c)["registered"]
+        assert not register_run(c, host="B")["registered"]
+        assert c.stats()["runs"]["k"]["shards"] == 6
+
+    def test_unknown_run_is_an_error_not_a_crash(self):
+        c = ShardCoordinator(clock=FakeClock())
+        assert "error" in c.lease("A", "nope")
+        assert "error" in c.done("A", "nope", 0)
+        assert "error" in c.steal("A", "nope")
+
+    def test_locality_routes_cached_range_first(self):
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c)
+        # B's cache holds blocks 4 and 5 (block_size 100) — exactly
+        # shard 4's and 5's byte ranges: they must lease first even
+        # though shards 0..3 are older in the queue.
+        r = c.lease("B", "k", want=2, block_size=100, blocks=[4, 5])
+        assert r["shards"] == [4, 5]
+        run = c.stats()["runs"]["k"]
+        assert run["locality_hits"] == 2 and run["locality_misses"] == 0
+        # no hints ⇒ plain FIFO, counted as misses
+        r = c.lease("A", "k", want=2)
+        assert r["shards"] == [0, 1]
+        run = c.stats()["runs"]["k"]
+        assert run["locality_misses"] == 2
+        assert run["locality_hit_rate"] == 0.5
+
+    def test_lease_expiry_requeues_and_books_member_loss(self):
+        clock = FakeClock()
+        c = ShardCoordinator(lease_s=5.0, clock=clock)
+        register_run(c)
+        assert c.lease("A", "k", want=2)["shards"] == [0, 1]
+        clock.t = 5.1  # past lease_s: A's leases expire on B's request
+        r = c.lease("B", "k", want=10)
+        assert r["shards"] == [0, 1, 2, 3, 4, 5]
+        run = c.stats(key="k")["runs"]["k"]
+        assert sorted(run["requeued"]) == [0, 1]
+        # A silent past 2×lease_s with no leases left ⇒ dropped
+        # (B leased at 5.1, so at 14.0 it is still inside its window)
+        clock.t = 14.0
+        assert "A" not in c.stats()["members"]
+        assert "B" in c.stats()["members"]
+
+    def test_steal_takes_oldest_stale_lease_from_most_loaded(self):
+        clock = FakeClock()
+        c = ShardCoordinator(lease_s=100.0, steal_after_s=1.0,
+                             clock=clock)
+        register_run(c)
+        c.lease("A", "k", want=4)          # A holds 0..3
+        clock.t = 0.5
+        c.lease("B", "k", want=2)          # B holds 4, 5 (younger)
+        # C idle: nothing stale yet
+        assert c.steal("C", "k")["shards"] == []
+        clock.t = 1.2                      # A's leases now stale, B's not
+        r = c.steal("C", "k")
+        assert r["shards"] == [0] and r["victim"] == "A"
+        # the stolen lease now belongs to C; first done wins
+        assert c.done("A", "k", 0)["won"]          # victim finished first
+        assert not c.done("C", "k", 0)["won"]      # thief's dup dropped
+        run = c.stats()["runs"]["k"]
+        assert run["stolen"] == [0] and run["done"]["0"] == "A"
+
+    def test_done_idempotent_for_winner_loses_for_other_host(self):
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c)
+        c.lease("A", "k", want=1)
+        assert c.done("A", "k", 0)["won"]
+        assert c.done("A", "k", 0)["won"]      # retried POST: still won
+        assert not c.done("B", "k", 0)["won"]  # lost race: dropped
+
+    def test_stale_epoch_callers_are_fenced_off_the_new_pass(self):
+        c = ShardCoordinator(clock=FakeClock())
+        e1 = register_run(c, host="A")  # pass 1
+        assert e1["epoch"] == 1
+        for s in range(6):
+            c.lease("A", "k", want=1)
+            c.done("A", "k", s, epoch=1)
+        # A re-registers (new pass); B still carries epoch 1
+        e2 = register_run(c, host="A")
+        assert e2["registered"] and e2["epoch"] == 2
+        r = c.lease("B", "k", want=4, epoch=1)
+        assert r["shards"] == [] and r["finished"] and r["stale"]
+        assert c.steal("B", "k", epoch=1)["stale"]
+        assert not c.done("B", "k", 3, epoch=1)["won"]
+        assert 3 in c.stats()["runs"]["k"]["pending"]  # pass 2 intact
+
+    def test_static_filter_restricts_to_residue_class(self):
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c)
+        r = c.lease("A", "k", want=10, static_of=(1, 2))
+        assert r["shards"] == [1, 3, 5]
+        assert c.lease("A", "k", want=10, static_of=(1, 2))["shards"] == []
+        r = c.lease("B", "k", want=10, static_of=(0, 2))
+        assert r["shards"] == [0, 2, 4]
+
+    def test_late_done_of_expired_lease_still_wins_once(self):
+        clock = FakeClock()
+        c = ShardCoordinator(lease_s=1.0, clock=clock)
+        register_run(c)
+        c.lease("A", "k", want=1)
+        clock.t = 1.5
+        c.stats()  # sweep: shard 0 back in pending
+        assert 0 in c.stats()["runs"]["k"]["pending"]
+        assert c.done("A", "k", 0)["won"]  # late completion wins...
+        assert 0 not in c.stats()["runs"]["k"]["pending"]  # ...and retracts
+        r = c.lease("B", "k", want=10)
+        assert 0 not in r["shards"]
+
+
+class TestHttpPlane:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from disq_tpu.runtime.introspect import reset_introspection
+
+        yield
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+    def test_endpoints_over_live_server(self):
+        addr = scheduler.serve_coordinator(lease_s=30.0)
+        cl = SchedulerClient(addr, "hA", lease_n=2)
+        doc = cl.join({"key": "httprun", "path": "p",
+                       "shards": {str(i): [i, i + 1] for i in range(3)}})
+        assert doc["registered"]
+        r = cl.lease()
+        assert r["shards"] == [0, 1]
+        assert cl.done(0)["won"]
+        # a retried done from the WINNER stays won (idempotent — the
+        # client retries lost responses); another host's dup loses
+        assert cl.done(0)["won"] is True
+        other = SchedulerClient(addr, "hB")
+        other.run_key, other.epoch = cl.run_key, cl.epoch
+        assert other.done(0)["won"] is False
+        r = cl.lease()
+        assert r["shards"] == [2]
+        for s in (1, 2):
+            cl.done(s)
+        assert cl.lease()["finished"]
+        stats = json.load(urllib.request.urlopen(
+            f"http://{addr}/sched/stats", timeout=10))
+        assert stats["runs"]["httprun"]["finished"]
+        assert set(stats["members"]) == {"hA", "hB"}
+
+    def test_sched_paths_without_coordinator_answer_409(self):
+        from disq_tpu.runtime.introspect import start_introspect_server
+
+        addr = start_introspect_server(0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{addr}/sched/stats",
+                                   timeout=10)
+        assert ei.value.code == 409
+
+    def test_bad_post_body_is_400_not_crash(self):
+        addr = scheduler.serve_coordinator()
+        req = urllib.request.Request(
+            f"http://{addr}/sched/lease", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+
+
+def _digest(batch) -> str:
+    h = hashlib.sha1()
+    for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+        h.update(np.ascontiguousarray(getattr(batch, f)).tobytes())
+    return h.hexdigest()
+
+
+def _fixture(tmp_path, n=1500, seed=3):
+    from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+    p = tmp_path / "in.bam"
+    p.write_bytes(make_bam_bytes(DEFAULT_REFS, synth_records(n, seed=seed),
+                                 blocksize=600))
+    return str(p)
+
+
+class TestScheduledRead:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from disq_tpu.runtime.introspect import reset_introspection
+
+        yield
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+    def test_off_by_default_returns_inline_generator(self):
+        from disq_tpu.api import ReadsStorage
+        from disq_tpu.runtime.executor import (
+            ShardPipelineExecutor, ShardTask)
+
+        gen = scheduled_map_ordered(
+            ReadsStorage.make_default(), None, "x",
+            ShardPipelineExecutor(workers=1),
+            [ShardTask(shard_id=0, fetch=lambda: 1,
+                       decode=lambda p: p)])
+        assert gen.gi_code.co_name == "_run_sequential"
+        assert [r.value for r in gen] == [1]
+        assert scheduler.active_coordinator() is None
+
+    def test_client_for_storage_env_resolution(self, monkeypatch):
+        from disq_tpu.api import ReadsStorage
+
+        st = ReadsStorage.make_default()
+        assert client_for_storage(st) is None
+        monkeypatch.setenv("DISQ_TPU_SCHED", "127.0.0.1:59999")
+        monkeypatch.setenv("DISQ_TPU_SCHED_LEASE_N", "5")
+        monkeypatch.setenv("DISQ_TPU_SCHED_STEAL", "0")
+        monkeypatch.setenv("DISQ_TPU_SCHED_HOST", "hX")
+        monkeypatch.setenv("DISQ_TPU_SCHED_STATIC", "1,4")
+        cl = client_for_storage(st)
+        assert (cl.address, cl.host, cl.lease_n, cl.steal,
+                cl.static_of, cl.serves) == (
+            "127.0.0.1:59999", "hX", 5, False, (1, 4), False)
+
+    def test_single_worker_scheduled_read_byte_identical(self, tmp_path):
+        from disq_tpu.api import ReadsStorage
+
+        path = _fixture(tmp_path)
+        base = ReadsStorage.make_default().split_size(4096).read(path)
+        ds = (ReadsStorage.make_default().split_size(4096)
+              .scheduler("serve").read(path))
+        assert ds.count() == base.count()
+        for f in ("refid", "pos", "mapq", "flag", "next_refid",
+                  "next_pos", "tlen", "seqs", "quals", "names",
+                  "cigars", "seq_offsets", "name_offsets"):
+            np.testing.assert_array_equal(
+                getattr(base.reads, f), getattr(ds.reads, f), err_msg=f)
+        # counters survive the scheduled loop
+        assert ds.counters.records == base.counters.records
+
+    def test_repeated_read_starts_a_fresh_pass(self, tmp_path):
+        """A second read of the same input by a participant must NOT
+        join the finished pass and emit nothing — it re-registers a
+        fresh run.  A host that never participated joining a finished
+        run stays empty (it arrived after the work was done)."""
+        from disq_tpu.api import ReadsStorage
+
+        path = _fixture(tmp_path, n=400)
+        st = (ReadsStorage.make_default().split_size(8192)
+              .scheduler("serve"))
+        first = st.read(path)
+        second = st.read(path)
+        assert second.count() == first.count() > 0
+        # a never-seen host joining the finished pass gets nothing
+        cl = SchedulerClient(
+            scheduler.serve_coordinator(), "latecomer")
+        run_key = next(iter(
+            scheduler.active_coordinator().stats()["runs"]))
+        cl.join({"key": run_key, "path": path, "shards": {}})
+        assert cl.lease()["finished"]
+
+    def test_two_inprocess_workers_partition_exactly_once(self, tmp_path):
+        import threading
+
+        from disq_tpu.api import ReadsStorage
+        from disq_tpu.bam.source import BamSource, read_header
+        from disq_tpu.fsw.filesystem import resolve_path
+
+        path = _fixture(tmp_path)
+        addr = scheduler.serve_coordinator(lease_s=30.0,
+                                           steal_after_s=0.05)
+        # single-host truth
+        src0 = BamSource(ReadsStorage.make_default().split_size(4096))
+        fs, p = resolve_path(path)
+        header, fv = read_header(fs, p)
+        truth = {}
+        batches = src0.read_split_batches(fs, p, header, fv)
+        for c, b in zip(src0._last_counters, batches):
+            truth[c.shard_id] = _digest(b)
+
+        results = {}
+
+        def worker(host, delay):
+            from disq_tpu.runtime.executor import (
+                ShardPipelineExecutor, ShardTask)
+
+            src = BamSource(ReadsStorage.make_default().split_size(4096))
+            hdr, first = read_header(fs, p)
+            # rebuild the same tasks the source builds, with a decode
+            # delay on the slow host to force overlap + stealing
+            import functools
+
+            from disq_tpu.runtime.errors import (
+                ErrorPolicy, ShardErrorContext)
+
+            ctx = ShardErrorContext(policy=ErrorPolicy.STRICT, path=p)
+            splits_done = {}
+            sbi = src._try_load_sbi(fs, p)
+            from disq_tpu.fsw.filesystem import compute_path_splits
+
+            splits = compute_path_splits(fs, p, 4096)
+            bounds = src._split_boundaries(fs, p, hdr, first, splits,
+                                           sbi, ctx=ctx)
+            tasks = []
+            for i in range(len(splits)):
+                lo, hi = bounds[i], bounds[i + 1]
+                shard_ctx = ctx.for_shard(i)
+
+                def decode(fetched, _s=shard_ctx, _d=delay):
+                    time.sleep(_d)
+                    return src._decode_fetched(hdr, fetched, ctx=_s)
+
+                tasks.append(ShardTask(
+                    shard_id=i,
+                    fetch=functools.partial(
+                        src._fetch_range, fs, p, lo, hi, shard_ctx),
+                    decode=decode,
+                    byte_range=(lo >> 16, (hi >> 16) + 1)))
+            cl = SchedulerClient(addr, host, lease_n=2, steal=True)
+            ex = ShardPipelineExecutor(workers=1)
+            for res in _scheduled_iter(cl, None, fs, p, ex, tasks, None):
+                splits_done[res.shard_id] = _digest(res.value[0])
+            results[host] = splits_done
+
+        slow = threading.Thread(target=worker, args=("slow", 0.12))
+        fast = threading.Thread(target=worker, args=("fast", 0.0))
+        slow.start()
+        time.sleep(0.05)
+        fast.start()
+        slow.join(timeout=120)
+        fast.join(timeout=120)
+        got = {}
+        for host, shards in results.items():
+            for sid, dig in shards.items():
+                assert sid not in got, f"shard {sid} emitted twice"
+                got[sid] = dig
+        assert got == truth
+        run = scheduler.active_coordinator().stats()["runs"][
+            scheduler.run_key_for(p, len(truth))]
+        assert run["finished"]
+        # both hosts really participated
+        assert len(set(run["done"].values())) == 2
+
+
+_KILL_WORKER = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from disq_tpu import ReadsStorage
+from disq_tpu.bam import source as bam_source
+from disq_tpu.bam.source import BamSource, read_header
+from disq_tpu.fsw.filesystem import resolve_path
+
+# Wedge shard {wedge}'s decode for 300s: the worker leases and
+# completes (and spills) the shards before it, then hangs holding a
+# live lease until SIGKILL.  (A faultfs byte-offset stall cannot
+# target a mid-file shard here: the BGZF walk stages 8 MB chunks, so
+# every shard's first range read covers the whole fixture.)
+_orig = BamSource._decode_fetched
+
+def _wedged(self, header, fetched, ctx=None):
+    if ctx is not None and ctx.shard_id == {wedge}:
+        time.sleep(300.0)
+    return _orig(self, header, fetched, ctx=ctx)
+
+BamSource._decode_fetched = _wedged
+st = (ReadsStorage.make_default().split_size({split})
+      .read_ledger({ledger!r}))
+src = BamSource(st)
+fs, p = resolve_path({path!r})
+header, fv = read_header(fs, p)
+src.read_split_batches(fs, p, header, fv)
+os._exit(3)  # unreachable: the wedge outlives the SIGKILL
+"""
+
+_SUCCESSOR_WORKER = r"""
+import hashlib, json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from disq_tpu import ReadsStorage
+from disq_tpu.bam.source import BamSource, read_header
+from disq_tpu.fsw.filesystem import resolve_path
+
+# Same path string as the dead worker (run key + ledger fingerprint
+# must match), no wedge.
+st = (ReadsStorage.make_default().split_size({split})
+      .read_ledger({ledger!r}))
+src = BamSource(st)
+fs, p = resolve_path({path!r})
+header, fv = read_header(fs, p)
+batches = src.read_split_batches(fs, p, header, fv)
+digests = {{}}
+for c, b in zip(src._last_counters, batches):
+    h = hashlib.sha1()
+    for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+        h.update(np.ascontiguousarray(getattr(b, f)).tobytes())
+    digests[str(c.shard_id)] = h.hexdigest()
+print(json.dumps(digests))
+"""
+
+
+class TestKillHandoff:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from disq_tpu.runtime.introspect import reset_introspection
+
+        yield
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+    def test_sigkill_requeues_exactly_unfinished_and_resumes_from_ledger(
+            self, tmp_path):
+        """The satellite-3 contract end to end: kill a leased worker,
+        assert the coordinator re-queues exactly its unfinished
+        leases, the successor re-decodes only those (the dead
+        worker's completed shards come from its ReadLedger spills),
+        and the assembled shard set is byte-identical to a
+        single-host read."""
+        from disq_tpu.api import ReadsStorage
+        from disq_tpu.bam.source import BamSource, read_header
+        from disq_tpu.fsw.filesystem import resolve_path
+        from disq_tpu.runtime.manifest import ReadLedger
+
+        from disq_tpu.api import SbiWriteOption
+
+        split = 32768
+        # The fixture carries its .sbi so split boundaries come from
+        # the index — the victim reaches the queue fast and its wedge
+        # fires inside a LEASED shard's decode, not a driver phase.
+        raw = _fixture(tmp_path, n=9000, seed=9)
+        path = str(tmp_path / "kill.bam")
+        ds0 = ReadsStorage.make_default().read(raw)
+        ReadsStorage.make_default().num_shards(4).write(
+            ds0, path, SbiWriteOption.ENABLE)
+        ledger_dir = str(tmp_path / "ledger")
+        # lease_n=2 ⇒ the victim completes [0, 1], then wedges decoding
+        # shard 2 while also holding shard 3's lease
+        wedge = 2
+
+        # single-host truth (plain posix path — identical bytes)
+        src0 = BamSource(ReadsStorage.make_default().split_size(split))
+        fs0, p0 = resolve_path(path)
+        header, fv = read_header(fs0, p0)
+        truth = {}
+        truth_batches = src0.read_split_batches(fs0, p0, header, fv)
+        for c, b in zip(src0._last_counters, truth_batches):
+            truth[str(c.shard_id)] = _digest(b)
+        assert len(truth) >= 5, "fixture too small for a handoff story"
+
+        addr = scheduler.serve_coordinator(lease_s=0.9,
+                                           steal_after_s=0.3)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "DISQ_TPU_SCHED": addr, "DISQ_TPU_SCHED_HOST": "victim",
+               "DISQ_TPU_SCHED_LEASE_N": "2"}
+        victim = subprocess.Popen(
+            [sys.executable, "-c", _KILL_WORKER.format(
+                repo=REPO, path=path, split=split, wedge=wedge,
+                ledger=ledger_dir)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env)
+
+        # wait until the victim completed >=1 shard and is wedged
+        # holding >=1 lease, then SIGKILL it mid-lease
+        run_key = scheduler.run_key_for(path, len(truth))
+        deadline = time.monotonic() + 120
+        run = None
+        while time.monotonic() < deadline:
+            if victim.poll() is not None:
+                pytest.fail("victim exited early: "
+                            + victim.stderr.read().decode()[-500:])
+            run = scheduler.active_coordinator().stats().get(
+                "runs", {}).get(run_key)
+            if run and run["done"] and run["leases"] and max(
+                    lease["age_s"]
+                    for lease in run["leases"].values()) > 0.4:
+                break
+            time.sleep(0.02)
+        else:
+            victim.kill()
+            pytest.fail(f"victim never reached kill state: {run}")
+        victim_done = set(run["done"])
+        victim_leased = {int(s) for s in run["leases"]}
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        assert victim_done and victim_leased
+
+        # every completed shard was spilled BEFORE its done
+        ledger = ReadLedger(ledger_dir)
+        assert {str(k) for k in ledger.completed_shards()} >= victim_done
+
+        # stealing off: the successor must get the dead worker's
+        # shards through LEASE EXPIRY (the crash-detector path), so
+        # the exact-requeue assertion below is deterministic — the
+        # steal path is covered by TestCoordinator + the chaos leg
+        env2 = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "DISQ_TPU_SCHED": addr,
+                "DISQ_TPU_SCHED_HOST": "successor",
+                "DISQ_TPU_SCHED_LEASE_N": "2",
+                "DISQ_TPU_SCHED_STEAL": "0"}
+        successor = subprocess.run(
+            [sys.executable, "-c", _SUCCESSOR_WORKER.format(
+                repo=REPO, path=path, split=split, ledger=ledger_dir)],
+            capture_output=True, text=True, timeout=240, env=env2)
+        assert successor.returncode == 0, successor.stderr[-800:]
+        succ_digests = json.loads(
+            successor.stdout.strip().splitlines()[-1])
+
+        run = scheduler.active_coordinator().stats()["runs"][run_key]
+        assert run["finished"]
+        # 1. the coordinator re-queued EXACTLY the unfinished leases
+        assert set(run["requeued"]) == victim_leased
+        # 2. the successor decoded exactly the complement of the dead
+        #    worker's completed shards — resumed, never re-decoded
+        assert set(succ_digests) == set(truth) - victim_done
+        assert {int(s) for s in run["done"]} == {
+            int(s) for s in truth}
+        for s in victim_done:
+            assert run["done"][s] == "victim"
+        # 3. byte identity: victim's shards from the shared ledger
+        #    spills + successor's shards == the single-host read
+        assembled = dict(succ_digests)
+        for s in victim_done:
+            batch, _stats = ledger.load(int(s))
+            assembled[s] = _digest(batch)
+        assert assembled == truth
