@@ -27,6 +27,7 @@ __version__ = "0.1.0"
 
 from disq_tpu.api import (  # noqa: F401
     ReadsStorage,
+    ServeHandle,
     VariantsStorage,
     ReadsDataset,
     VariantsDataset,
@@ -41,6 +42,7 @@ from disq_tpu.api import (  # noqa: F401
     CraiWriteOption,
     TabixIndexWriteOption,
     StageManifestWriteOption,
+    serve,
 )
 from disq_tpu.runtime import (  # noqa: F401
     BreakerOpenError,

@@ -799,3 +799,80 @@ class VariantsStorage:
         except Exception as e:
             flightrec.note_abort(e, where="write")
             raise
+
+
+class ServeHandle:
+    """Handle on the serving plane started by :func:`serve`.
+
+    ``address`` is the ``host:port`` of the HTTP plane now answering
+    ``POST /query/reads``, ``POST /query/variants``,
+    ``POST /query/stats``, ``POST /serve/register`` and
+    ``GET /serve/stats`` alongside the existing introspection
+    endpoints. ``close()`` tears the daemon down (and the HTTP server,
+    when :func:`serve` started it)."""
+
+    def __init__(self, address: str, daemon, owns_server: bool) -> None:
+        self.address = address
+        self.daemon = daemon
+        self._owns_server = owns_server
+
+    def register(self, name: str, path: str, kind: str = None) -> dict:
+        """Register a dataset by path; ``kind`` is sniffed from the
+        extension when omitted ('reads' | 'variants')."""
+        return self.daemon.register(name, path, kind)
+
+    def stats(self) -> dict:
+        return self.daemon.stats()
+
+    def close(self) -> None:
+        from disq_tpu.runtime import serve as serve_mod
+        from disq_tpu.runtime.introspect import stop_introspect_server
+
+        serve_mod.stop_serve()
+        if self._owns_server:
+            stop_introspect_server()
+
+    def __enter__(self) -> "ServeHandle":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def serve(datasets: dict = None, *, port: int = 0, options=None,
+          tenant_slots: int = None, tenant_queue: int = None,
+          compressed_cache_mb: int = None,
+          decoded_cache_mb: int = None,
+          parsed_cache_mb: int = None) -> ServeHandle:
+    """Start the long-lived multi-tenant interval-query daemon
+    (``runtime/serve.py``) and return a :class:`ServeHandle`.
+
+    ``datasets`` maps name -> path to register up front; more can be
+    added later via ``handle.register`` or ``POST /serve/register``.
+    Queries are answered over the introspection HTTP plane with
+    cross-request device batching, a shared hot cache (compressed
+    blocks, decoded payloads, parsed chunk batches), and per-tenant
+    admission control (``tenant_slots`` concurrent requests per tenant
+    plus a ``tenant_queue``-deep wait queue; beyond that a tenant's
+    requests are shed with 429)."""
+    from disq_tpu.runtime import serve as serve_mod
+    from disq_tpu.runtime.introspect import introspect_address
+
+    kwargs = {"options": options}
+    if tenant_slots is not None:
+        kwargs["tenant_slots"] = tenant_slots
+    if tenant_queue is not None:
+        kwargs["tenant_queue"] = tenant_queue
+    if compressed_cache_mb is not None:
+        kwargs["compressed_cache_mb"] = compressed_cache_mb
+    if decoded_cache_mb is not None:
+        kwargs["decoded_cache_mb"] = decoded_cache_mb
+    if parsed_cache_mb is not None:
+        kwargs["parsed_cache_mb"] = parsed_cache_mb
+    owns_server = introspect_address() is None
+    address = serve_mod.start_serve(port, **kwargs)
+    handle = ServeHandle(address, serve_mod.serve_if_running(),
+                         owns_server)
+    for name, path in (datasets or {}).items():
+        handle.register(name, path)
+    return handle

@@ -553,6 +553,13 @@ class _NamedThreadingHTTPServer(ThreadingHTTPServer):
     one blocking inside ``/debug/profile``) would profile as
     ``other``."""
 
+    # socketserver's default listen backlog of 5 predates the serving
+    # plane: a burst of concurrent query clients (bench config 13 runs
+    # 32) overflows it and the kernel resets the excess connects before
+    # the accept loop ever sees them. Admission control must be the
+    # thing that sheds load, not the listen queue.
+    request_queue_size = 128
+
     def process_request_thread(self, request, client_address):
         threading.current_thread().name = "disq-introspect-req"
         super().process_request_thread(request, client_address)
@@ -560,6 +567,18 @@ class _NamedThreadingHTTPServer(ThreadingHTTPServer):
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "disq-tpu-introspect/1"
+    # Keep-alive matters once the serving plane (runtime/serve.py) runs
+    # query traffic over this endpoint: a closed-loop client holds one
+    # connection instead of paying connect+teardown per request. Safe
+    # because every response goes through _send, which always sets
+    # Content-Length. The socket timeout reaps idle parked connections
+    # so handler threads never outlive their client by more than this.
+    protocol_version = "HTTP/1.1"
+    timeout = 30
+    # Headers and body leave as separate writes; with Nagle on, the
+    # second segment waits out the peer's delayed ACK (~40 ms) — a
+    # latency floor that buries every sub-millisecond cache hit.
+    disable_nagle_algorithm = True
 
     def log_message(self, *args: Any) -> None:  # quiet by design
         pass
@@ -640,23 +659,34 @@ class _Handler(BaseHTTPRequestHandler):
                 }, 409)
             else:
                 self._send_json({"bundle": bundle, "run_id": RUN_ID})
+        elif path == "/serve/stats":
+            # Serving plane (runtime/serve.py): resolved only when a
+            # /serve/* request actually arrives, so the serve-off path
+            # never imports or allocates anything here.
+            from disq_tpu.runtime import serve
+
+            code, body = serve.handle_http("GET", path, {})
+            self._send_json(body, code)
         else:
             self._send_json({"error": "unknown path", "endpoints": [
                 "/metrics", "/healthz", "/progress", "/spans",
                 "/debug/stacks", "/debug/profile", "/debug/bundle",
-                "/sched/stats"]},
+                "/sched/stats", "/serve/stats"]},
                 404)
 
     def do_POST(self) -> None:  # noqa: N802 — http.server API
-        """The scheduler plane's mutating endpoints
-        (``/sched/join|lease|done|steal`` — runtime/scheduler.py).
-        Everything else is GET-only."""
+        """The mutating endpoints: the scheduler plane
+        (``/sched/join|lease|done|steal`` — runtime/scheduler.py) and
+        the serving plane (``/query/reads|variants|stats``,
+        ``/serve/register`` — runtime/serve.py). Everything else is
+        GET-only. Both planes are resolved lazily per request so the
+        disabled paths import and allocate nothing."""
         path, _, _query = self.path.partition("?")
-        if not path.startswith("/sched/"):
-            self._send_json({"error": "POST only serves /sched/*"}, 404)
+        if not path.startswith(("/sched/", "/query/", "/serve/")):
+            self._send_json(
+                {"error": "POST only serves /sched/*, /query/* and "
+                          "/serve/*"}, 404)
             return
-        from disq_tpu.runtime import scheduler
-
         try:
             length = int(self.headers.get("Content-Length") or 0)
             doc = json.loads(self.rfile.read(length)) if length else {}
@@ -665,7 +695,14 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, OSError) as e:
             self._send_json({"error": f"bad request body: {e}"}, 400)
             return
-        code, body = scheduler.handle_http("POST", path, doc)
+        if path.startswith("/sched/"):
+            from disq_tpu.runtime import scheduler
+
+            code, body = scheduler.handle_http("POST", path, doc)
+        else:
+            from disq_tpu.runtime import serve
+
+            code, body = serve.handle_http("POST", path, doc)
         self._send_json(body, code)
 
     def _serve_profile(self, query: str) -> None:

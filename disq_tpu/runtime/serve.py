@@ -1,0 +1,853 @@
+"""Serving plane — a long-lived multi-tenant interval-query daemon
+(ROADMAP item 2).
+
+Everything before this module is batch-job shaped: one process, one
+read, one write. The workload this system reproduces is fundamentally
+*many concurrent region queries over shared indexed files*, so this
+module composes the pieces PRs 5–12 already shipped into a serving
+path measured in p50/p99 latency under concurrency:
+
+- **Endpoints** ride the existing introspection HTTP plane
+  (``runtime/introspect.py``): ``POST /query/reads``,
+  ``POST /query/variants``, ``POST /query/stats``,
+  ``GET /serve/stats`` and ``POST /serve/register`` all funnel through
+  :func:`handle_http`, resolved lazily by the handler so the serve-off
+  path imports and allocates nothing.
+- **Cross-request device batching**: every cache-missing BGZF block a
+  request needs is submitted to the device decode service
+  (``runtime/device_service.py``) in one ``submit_inflate`` batch, so
+  concurrent tenants' independent requests coalesce into full 128-lane
+  inflate launches — the cross-shard coalescing the service already
+  does within one run, applied across requests.
+- **Shared hot-block cache**: a process-wide two-tier LRU keyed
+  ``(path, coffset)`` — tier "compressed" holds raw BGZF block bytes
+  (saves the storage round-trip), tier "decoded" holds inflated
+  payloads (saves the inflate) — with per-tenant byte accounting, so a
+  hot region never pays inflate twice no matter which tenant warmed it.
+- **Per-tenant QoS**: admission control in the spirit of
+  ``runtime/resilience.py``'s RetryBudget/CircuitBreaker — each tenant
+  gets a fixed number of concurrency slots plus a bounded wait queue;
+  past that, requests are shed with HTTP 429 so one abusive tenant
+  cannot blow up everyone else's p99.
+- **Index/header LRU**: parsed headers and BAI/TBI indexes are cached
+  per path, keyed by ``(path, size, mtime)`` so a rewritten file
+  invalidates naturally.
+
+Zero-overhead-when-off contract (guarded by
+``scripts/check_overhead.py``): no daemon, no cache, no admission
+state and no thread exists until :func:`start_serve` runs;
+:func:`serve_if_running` NEVER creates, and :func:`handle_http`
+answers 503 without allocating when the daemon is down. The daemon
+itself owns no threads — requests execute on the introspect server's
+request threads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from disq_tpu.runtime.tracing import (
+    counter, gauge, histogram, record_span)
+
+DEFAULT_TENANT = "anon"
+
+# Two-tier cache defaults (bytes). Decoded payloads are ~3-4x the
+# compressed blocks for genomic data, so the decoded tier gets more.
+DEFAULT_COMPRESSED_CACHE_MB = 64
+DEFAULT_DECODED_CACHE_MB = 128
+DEFAULT_PARSED_CACHE_MB = 128
+DEFAULT_TENANT_SLOTS = 4
+DEFAULT_TENANT_QUEUE = 16
+DEFAULT_INDEX_CACHE_ENTRIES = 16
+
+_BGZF_FOOTER = 8
+
+
+class AdmissionShed(Exception):
+    """Request shed by per-tenant admission control (HTTP 429)."""
+
+    def __init__(self, tenant: str, reason: str) -> None:
+        super().__init__(f"tenant {tenant!r} shed: {reason}")
+        self.tenant = tenant
+        self.reason = reason
+
+
+class TenantAdmission:
+    """Per-tenant concurrency slots + bounded wait queue.
+
+    A tenant holds at most ``slots`` requests in flight; up to
+    ``queue_depth`` more may wait for a slot; anything beyond that is
+    shed immediately (the caller maps :class:`AdmissionShed` to 429).
+    Queue wait is booked as a ``serve.admission.wait`` span so
+    ``trace_report --analyze`` can attribute p99 to queuing.
+    """
+
+    def __init__(self, slots: int = DEFAULT_TENANT_SLOTS,
+                 queue_depth: int = DEFAULT_TENANT_QUEUE,
+                 wait_timeout_s: float = 30.0) -> None:
+        if slots < 1:
+            raise ValueError(f"tenant slots must be >= 1, got {slots}")
+        if queue_depth < 0:
+            raise ValueError(
+                f"tenant queue depth must be >= 0, got {queue_depth}")
+        self.slots = slots
+        self.queue_depth = queue_depth
+        self.wait_timeout_s = wait_timeout_s
+        self._cond = threading.Condition()
+        self._active: Dict[str, int] = {}
+        self._queued: Dict[str, int] = {}
+
+    def acquire(self, tenant: str) -> None:
+        adm = counter("serve.admission")
+        with self._cond:
+            if self._active.get(tenant, 0) < self.slots:
+                self._active[tenant] = self._active.get(tenant, 0) + 1
+                adm.inc(result="admit", tenant=tenant)
+                return
+            if self._queued.get(tenant, 0) >= self.queue_depth:
+                adm.inc(result="shed", tenant=tenant)
+                raise AdmissionShed(
+                    tenant,
+                    f"{self._active.get(tenant, 0)} active, "
+                    f"{self._queued.get(tenant, 0)} queued")
+            self._queued[tenant] = self._queued.get(tenant, 0) + 1
+            adm.inc(result="queued", tenant=tenant)
+            t0 = time.perf_counter()
+            deadline = t0 + self.wait_timeout_s
+            try:
+                while self._active.get(tenant, 0) >= self.slots:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        adm.inc(result="shed", tenant=tenant)
+                        raise AdmissionShed(tenant, "queue wait timeout")
+                    self._cond.wait(remaining)
+                self._active[tenant] = self._active.get(tenant, 0) + 1
+            finally:
+                self._queued[tenant] -= 1
+                record_span("serve.admission.wait",
+                            time.perf_counter() - t0, tenant=tenant)
+
+    def release(self, tenant: str) -> None:
+        with self._cond:
+            self._active[tenant] = max(0, self._active.get(tenant, 0) - 1)
+            self._cond.notify_all()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            tenants = sorted(set(self._active) | set(self._queued))
+            return {
+                "slots": self.slots,
+                "queue_depth": self.queue_depth,
+                "tenants": {
+                    t: {"active": self._active.get(t, 0),
+                        "queued": self._queued.get(t, 0)}
+                    for t in tenants
+                },
+            }
+
+
+class HotBlockCache:
+    """Process-wide block/chunk LRU shared by every tenant.
+
+    Tier ``compressed`` maps ``(path, coffset)`` to the raw BGZF block
+    bytes (header + deflate payload + footer); tier ``decoded`` maps
+    the same key to ``(csize, payload)`` — the inflated payload plus
+    the compressed size needed to advance a block walk without
+    re-reading the file. Tier ``parsed`` sits above both, keyed
+    ``(path, (chunk_begin, chunk_end))`` by virtual-offset chunk, and
+    holds the fully decoded columnar batch (plus its precomputed
+    alignment ends for reads) — a hot repeated region skips inflate
+    AND record decode AND the cigar walk, leaving only the per-query
+    interval filter. Eviction is LRU per tier under a byte budget;
+    per-tenant resident bytes are accounted so ``/serve/stats`` can
+    show who owns the working set (the cache itself is shared — a hit
+    is a hit regardless of who inserted the block).
+    """
+
+    TIERS = ("compressed", "decoded", "parsed")
+
+    def __init__(self,
+                 compressed_bytes: int = DEFAULT_COMPRESSED_CACHE_MB << 20,
+                 decoded_bytes: int = DEFAULT_DECODED_CACHE_MB << 20,
+                 parsed_bytes: int = DEFAULT_PARSED_CACHE_MB << 20) -> None:
+        self._lock = threading.Lock()
+        self._cap = {"compressed": int(compressed_bytes),
+                     "decoded": int(decoded_bytes),
+                     "parsed": int(parsed_bytes)}
+        self._lru: Dict[str, OrderedDict] = {
+            t: OrderedDict() for t in self.TIERS}
+        self._bytes = {t: 0 for t in self.TIERS}
+        self._tenant_bytes: Dict[Tuple[str, str], int] = {}
+
+    def get(self, tier: str, path: str, coffset: int,
+            tenant: str) -> Optional[Any]:
+        with self._lock:
+            ent = self._lru[tier].get((path, coffset))
+            if ent is None:
+                counter("serve.cache.misses").inc(tier=tier, tenant=tenant)
+                return None
+            self._lru[tier].move_to_end((path, coffset))
+            counter("serve.cache.hits").inc(tier=tier, tenant=tenant)
+            return ent[0]
+
+    def put(self, tier: str, path: str, coffset: int, value: Any,
+            nbytes: int, tenant: str) -> None:
+        cap = self._cap[tier]
+        if nbytes > cap:
+            return
+        with self._lock:
+            lru = self._lru[tier]
+            key = (path, coffset)
+            if key in lru:
+                lru.move_to_end(key)
+                return
+            lru[key] = (value, nbytes, tenant)
+            self._bytes[tier] += nbytes
+            tk = (tier, tenant)
+            self._tenant_bytes[tk] = self._tenant_bytes.get(tk, 0) + nbytes
+            while self._bytes[tier] > cap and lru:
+                _, (_, ev_bytes, ev_tenant) = lru.popitem(last=False)
+                self._bytes[tier] -= ev_bytes
+                ek = (tier, ev_tenant)
+                self._tenant_bytes[ek] = max(
+                    0, self._tenant_bytes.get(ek, 0) - ev_bytes)
+                counter("serve.cache.evictions").inc(tier=tier)
+            gauge("serve.cache.bytes").observe(self._bytes[tier], tier=tier)
+
+    def clear(self) -> None:
+        with self._lock:
+            for t in self.TIERS:
+                self._lru[t].clear()
+                self._bytes[t] = 0
+            self._tenant_bytes.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                tier: {
+                    "blocks": len(self._lru[tier]),
+                    "bytes": self._bytes[tier],
+                    "capacity_bytes": self._cap[tier],
+                    "tenant_bytes": {
+                        tenant: n
+                        for (t, tenant), n in sorted(
+                            self._tenant_bytes.items())
+                        if t == tier and n > 0
+                    },
+                }
+                for tier in self.TIERS
+            }
+
+
+class IndexCache:
+    """Parsed header + index LRU keyed ``(path, size, mtime)``.
+
+    Before this cache every interval read re-fetched and re-parsed the
+    BAI/TBI; a daemon answering thousands of region queries against a
+    handful of registered files must parse each index once. The key
+    carries the file's ``(size, mtime_ns)`` stat so a rewritten file
+    invalidates on its next query (non-posix backends fall back to
+    size-only, which still catches every rewrite that changes length).
+    """
+
+    def __init__(self, entries: int = DEFAULT_INDEX_CACHE_ENTRIES) -> None:
+        self._lock = threading.Lock()
+        self._entries = int(entries)
+        self._lru: OrderedDict = OrderedDict()
+
+    @staticmethod
+    def _stat(fs, path: str) -> Tuple[int, int]:
+        try:
+            st = os.stat(path)
+            return int(st.st_size), int(st.st_mtime_ns)
+        except OSError:
+            return int(fs.get_file_length(path)), -1
+
+    def get(self, fs, path: str, build):
+        """Cached ``build(fs, path)`` result, invalidated on stat
+        change of ``path`` (the builder may parse sidecars too — their
+        rewrite accompanies the data file's in every supported
+        writer)."""
+        key = (path,) + self._stat(fs, path)
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                counter("serve.index_cache.hits").inc()
+                return self._lru[key]
+        counter("serve.index_cache.misses").inc()
+        value = build(fs, path)
+        with self._lock:
+            # drop stale generations of the same path, then LRU-bound
+            for stale in [k for k in self._lru if k[0] == path]:
+                del self._lru[stale]
+            self._lru[key] = value
+            while len(self._lru) > self._entries:
+                self._lru.popitem(last=False)
+        return value
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._lru),
+                "capacity": self._entries,
+                "hits": counter("serve.index_cache.hits").total(),
+                "misses": counter("serve.index_cache.misses").total(),
+            }
+
+
+class _Dataset:
+    """One registered dataset: resolved filesystem + kind."""
+
+    __slots__ = ("name", "path", "kind", "fs")
+
+    def __init__(self, name: str, path: str, kind: str, fs) -> None:
+        self.name = name
+        self.path = path
+        self.kind = kind
+        self.fs = fs
+
+
+def _parse_raw_block(raw: bytes) -> Tuple[bytes, int]:
+    """(deflate payload, usize) of one raw BGZF block."""
+    xlen = struct.unpack_from("<H", raw, 10)[0]
+    usize = struct.unpack_from("<I", raw, len(raw) - 4)[0]
+    return raw[12 + xlen: len(raw) - _BGZF_FOOTER], usize
+
+
+def _sniff_kind(path: str) -> str:
+    low = path.lower()
+    if low.endswith((".vcf.gz", ".vcf.bgz", ".vcf")):
+        return "variants"
+    return "reads"
+
+
+class ServeDaemon:
+    """Registry + query engine behind the ``/query/*`` endpoints.
+
+    Holds no threads: requests run on the introspect HTTP server's
+    request threads, synchronized only through the cache/admission
+    locks above.
+    """
+
+    def __init__(self, *, options=None,
+                 compressed_cache_mb: int = DEFAULT_COMPRESSED_CACHE_MB,
+                 decoded_cache_mb: int = DEFAULT_DECODED_CACHE_MB,
+                 parsed_cache_mb: int = DEFAULT_PARSED_CACHE_MB,
+                 tenant_slots: int = DEFAULT_TENANT_SLOTS,
+                 tenant_queue: int = DEFAULT_TENANT_QUEUE) -> None:
+        from disq_tpu.runtime.errors import DisqOptions, ShardRetrier
+
+        self._options = options or DisqOptions()
+        self.cache = HotBlockCache(compressed_cache_mb << 20,
+                                   decoded_cache_mb << 20,
+                                   parsed_cache_mb << 20)
+        self.indexes = IndexCache()
+        self.admission = TenantAdmission(tenant_slots, tenant_queue)
+        self._retrier = ShardRetrier(self._options.max_retries,
+                                     self._options.retry_backoff_s)
+        self._datasets: Dict[str, _Dataset] = {}
+        self._lock = threading.Lock()
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, name: str, path: str,
+                 kind: Optional[str] = None) -> Dict[str, Any]:
+        from disq_tpu.fsw.filesystem import resolve_path
+
+        kind = kind or _sniff_kind(path)
+        if kind not in ("reads", "variants"):
+            raise ValueError(f"unknown dataset kind {kind!r}")
+        fs, fs_path = resolve_path(path)
+        if not fs.exists(fs_path):
+            raise FileNotFoundError(path)
+        ds = _Dataset(name, fs_path, kind, fs)
+        with self._lock:
+            self._datasets[name] = ds
+            gauge("serve.datasets").observe(len(self._datasets))
+        return {"name": name, "path": path, "kind": kind}
+
+    def _dataset(self, doc: Dict[str, Any], kind: str) -> _Dataset:
+        name = doc.get("dataset")
+        if name is not None:
+            with self._lock:
+                ds = self._datasets.get(name)
+            if ds is None:
+                # 404, not 400 — the request is well-formed, the
+                # resource isn't there
+                raise FileNotFoundError(
+                    f"dataset {name!r} not registered")
+            return ds
+        path = doc.get("path")
+        if not path:
+            raise ValueError("request needs 'dataset' or 'path'")
+        # by-path queries auto-register under the path itself
+        with self._lock:
+            ds = self._datasets.get(path)
+        if ds is None:
+            self.register(path, path, kind)
+            with self._lock:
+                ds = self._datasets[path]
+        return ds
+
+    # -- cached index resolution ------------------------------------------
+
+    @staticmethod
+    def _build_bam_meta(fs, path: str):
+        from disq_tpu.bam.source import read_header
+        from disq_tpu.traversal.bai_query import _resolve_bai
+
+        header, first_vo = read_header(fs, path)
+        return header, first_vo, _resolve_bai(fs, path)
+
+    @staticmethod
+    def _build_vcf_meta(fs, path: str):
+        from disq_tpu.index.tbi import TbiIndex
+        from disq_tpu.vcf.header import read_vcf_header
+
+        header = read_vcf_header(fs, path)
+        tbi = TbiIndex.from_bytes(fs.read_all(path + ".tbi"))
+        return header, tbi
+
+    # -- the cached + batched block pipeline ------------------------------
+
+    def _chunk_blob(self, ds: _Dataset, cb: int, ce: int,
+                    tenant: str) -> bytes:
+        """Decoded bytes of virtual-offset chunk [cb, ce) — the serving
+        analogue of ``BamSource._fetch_range`` + inflate, with two
+        differences: every block goes through the shared two-tier
+        cache, and every cache-missing block of the request is
+        inflated in ONE device-service submission so concurrent
+        requests coalesce into full 128-lane launches."""
+        lo_block, lo_u = cb >> 16, cb & 0xFFFF
+        hi_block, hi_u = ce >> 16, ce & 0xFFFF
+        want_end = max(hi_block + (1 if hi_u > 0 else 0), lo_block + 1)
+        length = ds.fs.get_file_length(ds.path)
+
+        order: List[int] = []          # coffsets in file order
+        payloads: Dict[int, bytes] = {}  # coffset -> decoded payload
+        csizes: Dict[int, int] = {}
+        pending: List[Tuple[int, bytes, int]] = []  # (coffset, comp, usize)
+        pos = lo_block
+        while pos < want_end and pos < length:
+            ent = self.cache.get("decoded", ds.path, pos, tenant)
+            if ent is not None:
+                csize, payload = ent
+                order.append(pos)
+                payloads[pos] = payload
+                csizes[pos] = csize
+                pos += csize
+                continue
+            raw = self.cache.get("compressed", ds.path, pos, tenant)
+            if raw is not None:
+                comp, usize = _parse_raw_block(raw)
+                order.append(pos)
+                csizes[pos] = len(raw)
+                pending.append((pos, comp, usize))
+                pos += len(raw)
+                continue
+            # miss: walk+stage the rest of the chunk in one range read
+            # (retried through the shard retrier — transient storage
+            # faults must not 500 a tenant)
+            blocks, data = self._retrier.call(
+                self._walk, ds.fs, ds.path, pos, want_end, length,
+                what="serve.fetch")
+            if not blocks:
+                break
+            base = blocks[0].pos
+            for b in blocks:
+                raw_b = data[b.pos - base: b.end - base]
+                self.cache.put("compressed", ds.path, b.pos, raw_b,
+                               len(raw_b), tenant)
+                comp, _ = _parse_raw_block(raw_b)
+                order.append(b.pos)
+                csizes[b.pos] = b.csize
+                pending.append((b.pos, comp, b.usize))
+            pos = blocks[-1].end
+        if pending:
+            self._inflate_pending(ds, pending, payloads, csizes, tenant)
+        blob = b"".join(payloads[co] for co in order)
+        if hi_u > 0:
+            acc_before_hi = sum(
+                len(payloads[co]) for co in order if co < hi_block)
+            end_u = acc_before_hi + hi_u
+        else:
+            end_u = len(blob)
+        return blob[lo_u:end_u]
+
+    @staticmethod
+    def _walk(fs, path, pos, want_end, length):
+        from disq_tpu.bgzf.guesser import _walk_blocks_collect
+
+        return _walk_blocks_collect(
+            fs, path, pos, max(want_end, pos + 1), length)
+
+    def _inflate_pending(self, ds: _Dataset, pending, payloads, csizes,
+                         tenant: str) -> None:
+        """Inflate every cache-missing block of one request in a
+        single batch: through the device service when enabled (the
+        dispatcher coalesces lanes ACROSS concurrent requests), host
+        zlib otherwise. Decoded payloads land in the hot tier."""
+        from disq_tpu.runtime import device_service
+
+        if device_service.enabled():
+            sub = device_service.get_service().submit_inflate(
+                [comp for _, comp, _ in pending],
+                [usize for _, _, usize in pending])
+            blob, offsets = sub.result()
+            raw = blob.tobytes()
+            decoded = [
+                raw[int(offsets[i]): int(offsets[i + 1])]
+                for i in range(len(pending))
+            ]
+        else:
+            decoded = [
+                zlib.decompress(comp, -15, usize or 1)
+                for _, comp, usize in pending
+            ]
+        for (coffset, _comp, _usize), payload in zip(pending, decoded):
+            payloads[coffset] = payload
+            self.cache.put("decoded", ds.path, coffset,
+                           (csizes[coffset], payload), len(payload),
+                           tenant)
+
+    # -- query execution ---------------------------------------------------
+
+    @staticmethod
+    def _parse_intervals(doc: Dict[str, Any]):
+        from disq_tpu.api import Interval
+
+        raw = doc.get("intervals")
+        if not isinstance(raw, list) or not raw:
+            raise ValueError(
+                "request needs 'intervals': [{contig, start, end}, …]")
+        out = []
+        for iv in raw:
+            if not isinstance(iv, dict):
+                raise ValueError("each interval must be an object")
+            try:
+                out.append(Interval(str(iv["contig"]), int(iv["start"]),
+                                    int(iv["end"])))
+            except KeyError as e:
+                raise ValueError(f"interval missing {e.args[0]!r}")
+        return out
+
+    @staticmethod
+    def _batch_nbytes(batch, *extra) -> int:
+        return sum(v.nbytes for v in vars(batch).values()
+                   if hasattr(v, "nbytes")) \
+            + sum(a.nbytes for a in extra)
+
+    def _parsed_chunk(self, ds: _Dataset, header, cb: int, ce: int,
+                      tenant: str):
+        """(batch, alignment_ends) of one virtual-offset chunk through
+        the parsed tier — decode and the cigar walk are paid once per
+        chunk, not per request."""
+        from disq_tpu.bam.codec import decode_records, scan_record_offsets
+
+        ent = self.cache.get("parsed", ds.path, (cb, ce), tenant)
+        if ent is None:
+            record_bytes = self._chunk_blob(ds, cb, ce, tenant)
+            if not record_bytes:
+                return None
+            offsets = scan_record_offsets(record_bytes)
+            sub = decode_records(record_bytes, offsets, n_ref=header.n_ref)
+            ends = sub.alignment_ends()
+            ent = (sub, ends)
+            self.cache.put("parsed", ds.path, (cb, ce), ent,
+                           self._batch_nbytes(sub, ends), tenant)
+        return ent
+
+    def _read_batch(self, ds: _Dataset, intervals, tenant: str,
+                    materialize: bool = True):
+        """(header, filtered ReadBatch or None, count) covering
+        ``intervals`` — the cached, batched serving analogue of
+        ``read_with_traversal``. With ``materialize=False`` (count-only
+        queries: ``limit`` 0 and no digest) the per-request work is
+        just the vectorized overlap mask — no column copies, no
+        concat."""
+        from disq_tpu.bam.columnar import ReadBatch
+        from disq_tpu.traversal.bai_query import (
+            chunks_for_intervals, overlap_mask)
+
+        header, _first_vo, bai = self.indexes.get(
+            ds.fs, ds.path, self._build_bam_meta)
+        batches = []
+        count = 0
+        for cb, ce in chunks_for_intervals(header, bai, intervals):
+            ent = self._parsed_chunk(ds, header, cb, ce, tenant)
+            if ent is None:
+                continue
+            sub, ends = ent
+            mask = overlap_mask(sub, header, intervals, ends=ends)
+            if materialize:
+                batches.append(sub.filter(mask))
+            else:
+                count += int(mask.sum())
+        if not materialize:
+            return header, None, count
+        batch = (ReadBatch.concat(batches) if batches
+                 else ReadBatch.empty())
+        return header, batch, int(batch.count)
+
+    @staticmethod
+    def _batch_digest(batch) -> str:
+        h = hashlib.sha1()
+        for col in (batch.refid, batch.pos, batch.flag, batch.mapq,
+                    batch.tlen):
+            h.update(col.tobytes())
+        h.update(batch.names.tobytes())
+        h.update(batch.cigars.tobytes())
+        h.update(batch.seqs.tobytes())
+        h.update(batch.quals.tobytes())
+        return h.hexdigest()
+
+    def _q_reads(self, doc: Dict[str, Any], tenant: str) -> Dict[str, Any]:
+        ds = self._dataset(doc, "reads")
+        if ds.kind != "reads":
+            raise ValueError(f"dataset {ds.name!r} holds variants")
+        intervals = self._parse_intervals(doc)
+        limit = int(doc.get("limit", 100))
+        want_digest = bool(doc.get("digest", True))
+        # Count-only queries (limit 0, no digest) skip batch
+        # materialization: the answer is a mask sum per cached chunk.
+        header, batch, count = self._read_batch(
+            ds, intervals, tenant,
+            materialize=want_digest or limit > 0)
+        names = [s.name for s in header.sequences]
+        records = [
+            {
+                "name": batch.name(i),
+                "contig": (names[int(batch.refid[i])]
+                           if 0 <= int(batch.refid[i]) < len(names)
+                           else None),
+                "pos": int(batch.pos[i]) + 1,
+                "flag": int(batch.flag[i]),
+                "mapq": int(batch.mapq[i]),
+            }
+            for i in range(min(count, max(0, limit)))
+        ] if batch is not None else []
+        out = {
+            "dataset": ds.name,
+            "count": count,
+            "records": records,
+        }
+        # sha1 over every column is the cross-client identity check;
+        # latency-sensitive callers opt out with "digest": false
+        if want_digest:
+            out["digest"] = self._batch_digest(batch)
+        return out
+
+    def _q_variants(self, doc: Dict[str, Any],
+                    tenant: str) -> Dict[str, Any]:
+        from disq_tpu.vcf.columnar import VariantBatch, parse_vcf_lines
+        from disq_tpu.vcf.source import VcfSource
+
+        ds = self._dataset(doc, "variants")
+        if ds.kind != "variants":
+            raise ValueError(f"dataset {ds.name!r} holds reads")
+        intervals = self._parse_intervals(doc)
+        header, tbi = self.indexes.get(ds.fs, ds.path,
+                                       self._build_vcf_meta)
+        chunks = []
+        for iv in intervals:
+            chunks += tbi.chunks_for_interval(iv.contig, iv.start - 1,
+                                              iv.end)
+        chunks.sort()
+        merged: List[Tuple[int, int]] = []
+        for cb, ce in chunks:
+            if merged and cb <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], ce))
+            else:
+                merged.append((cb, ce))
+        batches = []
+        for cb, ce in merged:
+            sub = self.cache.get("parsed", ds.path, (cb, ce), tenant)
+            if sub is None:
+                blob = self._chunk_blob(ds, cb, ce, tenant)
+                lines = [
+                    ln for ln in blob.split(b"\n")
+                    if ln and not ln.startswith(b"#")
+                    and ln.count(b"\t") >= 7
+                ]
+                sub = parse_vcf_lines(lines, header.contig_names)
+                self.cache.put("parsed", ds.path, (cb, ce), sub,
+                               self._batch_nbytes(sub), tenant)
+            batches.append(sub)
+        batch = (VariantBatch.concat(batches) if batches
+                 else VariantBatch.empty(header.contig_names))
+        batch = batch.filter(VcfSource._overlap_mask(batch, intervals))
+        limit = int(doc.get("limit", 100))
+        out = {
+            "dataset": ds.name,
+            "count": int(batch.count),
+            "records": [batch.line(i)
+                        for i in range(min(int(batch.count),
+                                           max(0, limit)))],
+        }
+        if doc.get("digest", True):
+            h = hashlib.sha1()
+            h.update(batch.chrom.tobytes())
+            h.update(batch.pos.tobytes())
+            h.update(batch.lines.tobytes())
+            out["digest"] = h.hexdigest()
+        return out
+
+    def _q_stats(self, doc: Dict[str, Any], tenant: str) -> Dict[str, Any]:
+        ds = self._dataset(doc, "reads")
+        if ds.kind != "reads":
+            raise ValueError("/query/stats serves reads datasets")
+        intervals = self._parse_intervals(doc)
+        header, batch, _count = self._read_batch(ds, intervals, tenant)
+        from disq_tpu.api import ReadsDataset
+
+        view = ReadsDataset(header=header, reads=batch)
+        out: Dict[str, Any] = {"dataset": ds.name,
+                               "count": int(batch.count)}
+        which = doc.get("stat", "flagstat")
+        if which not in ("flagstat", "depth"):
+            raise ValueError(f"unknown stat {which!r}")
+        if which == "flagstat":
+            out["flagstat"] = {k: int(v)
+                               for k, v in view.flagstat().items()}
+        else:
+            window = int(doc.get("window", 1024))
+            depth = view.depth(window=window)
+            names = [s.name for s in header.sequences]
+            out["depth"] = {
+                "window": window,
+                "refs": {
+                    (names[int(refid)]
+                     if 0 <= int(refid) < len(names) else str(refid)): {
+                        "windows": int(len(arr)),
+                        "max": int(arr.max()) if len(arr) else 0,
+                        "total": int(arr.sum()) if len(arr) else 0,
+                    }
+                    for refid, arr in depth.items()
+                },
+            }
+        return out
+
+    # -- stats + HTTP ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        lat = histogram("serve.request")
+        with self._lock:
+            datasets = [
+                {"name": d.name, "path": d.path, "kind": d.kind}
+                for d in self._datasets.values()
+            ]
+        return {
+            "datasets": datasets,
+            "cache": self.cache.stats(),
+            "index_cache": self.indexes.stats(),
+            "admission": self.admission.stats(),
+            "latency": {
+                "p50_ms": lat.percentile(50) * 1e3,
+                "p99_ms": lat.percentile(99) * 1e3,
+                "p999_ms": lat.percentile(99.9) * 1e3,
+                "max_ms": lat.percentile(100) * 1e3,
+            },
+        }
+
+    _QUERIES = {
+        "/query/reads": "_q_reads",
+        "/query/variants": "_q_variants",
+        "/query/stats": "_q_stats",
+    }
+
+    def handle(self, method: str, path: str,
+               doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        if method == "GET" and path == "/serve/stats":
+            return 200, self.stats()
+        if method != "POST":
+            return 405, {"error": f"{path} expects POST"}
+        if path == "/serve/register":
+            try:
+                return 200, self.register(
+                    str(doc.get("name") or doc.get("path") or ""),
+                    str(doc["path"]), doc.get("kind"))
+            except KeyError:
+                return 400, {"error": "register needs 'path'"}
+            except (ValueError, FileNotFoundError) as e:
+                return 400, {"error": str(e)}
+        fn_name = self._QUERIES.get(path)
+        if fn_name is None:
+            return 404, {"error": f"unknown serve path {path}",
+                         "endpoints": sorted(self._QUERIES)
+                         + ["/serve/register", "/serve/stats"]}
+        tenant = str(doc.get("tenant") or DEFAULT_TENANT)
+        t0 = time.perf_counter()
+        endpoint = path.rsplit("/", 1)[-1]
+        try:
+            self.admission.acquire(tenant)
+        except AdmissionShed as e:
+            return 429, {"error": str(e), "tenant": tenant}
+        try:
+            body = getattr(self, fn_name)(doc, tenant)
+            return 200, body
+        except (KeyError, ValueError) as e:
+            return 400, {"error": str(e)}
+        except FileNotFoundError as e:
+            return 404, {"error": f"not found: {e}"}
+        except Exception as e:  # noqa: BLE001 — surfaced as HTTP 500
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            self.admission.release(tenant)
+            histogram("serve.request").observe(
+                time.perf_counter() - t0, endpoint=endpoint,
+                tenant=tenant)
+
+
+# -- module-level daemon lifecycle ----------------------------------------
+
+_LOCK = threading.RLock()
+_DAEMON: Optional[ServeDaemon] = None
+
+
+def serve_if_running() -> Optional[ServeDaemon]:
+    """The live daemon, or None. NEVER creates one — the overhead
+    guard (``scripts/check_overhead.py``) calls this to prove the
+    serve-off path allocates nothing."""
+    return _DAEMON
+
+
+def start_serve(port: int = 0, **daemon_kwargs: Any) -> str:
+    """Create the daemon (idempotent) and return the ``host:port`` of
+    the introspection HTTP server now also answering ``/query/*`` and
+    ``/serve/*``. Keyword args feed :class:`ServeDaemon` on first
+    start and are ignored on an already-running daemon."""
+    global _DAEMON
+    with _LOCK:
+        if _DAEMON is None:
+            _DAEMON = ServeDaemon(**daemon_kwargs)
+    from disq_tpu.runtime.introspect import start_introspect_server
+
+    return start_introspect_server(port)
+
+
+def stop_serve() -> None:
+    """Drop the daemon (registry, caches, admission state). The
+    introspection server is shared with the rest of the telemetry
+    plane, so the caller that started it stops it."""
+    global _DAEMON
+    with _LOCK:
+        _DAEMON = None
+
+
+def handle_http(method: str, path: str,
+                doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    """Route one serve-plane request; 503 (allocating nothing) when
+    no daemon is running."""
+    daemon = _DAEMON
+    if daemon is None:
+        return 503, {
+            "error": "serving plane not started — call "
+                     "disq_tpu.api.serve() or scripts/serve.py"}
+    return daemon.handle(method, path, doc)

@@ -52,13 +52,20 @@ def chunks_for_intervals(
 
 
 def overlap_mask(
-    batch: ReadBatch, header: SamHeader, intervals
+    batch: ReadBatch, header: SamHeader, intervals,
+    ends: np.ndarray = None,
 ) -> np.ndarray:
-    """Vectorized record-overlaps-any-interval mask (0-based half-open)."""
+    """Vectorized record-overlaps-any-interval mask (0-based half-open).
+
+    ``ends`` takes precomputed ``batch.alignment_ends()`` — the cigar
+    walk is the dominant cost here, and callers that filter the same
+    batch repeatedly (the serving plane's parsed-chunk cache) pay it
+    once instead of per query."""
     mask = np.zeros(batch.count, dtype=bool)
     if batch.count == 0:
         return mask
-    ends = batch.alignment_ends()
+    if ends is None:
+        ends = batch.alignment_ends()
     for iv in intervals:
         refid = header.ref_index(iv.contig)
         beg0, end0 = iv.start - 1, iv.end  # half-open

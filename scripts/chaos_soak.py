@@ -812,6 +812,158 @@ def kill_leg(path, tmp) -> str:
     return postmortem_check(tmp)
 
 
+def serve_leg(path, tmp) -> str:
+    """Tenant storm against the serving plane (runtime/serve.py): four
+    good tenants issue concurrent region queries through injected
+    transient read faults; then the abusive tenant's 2 slots + 2-deep
+    queue are pinned full and its further requests must shed. Contract:
+    every good tenant's query answers 200 with counts matching a
+    fault-free direct traversal read (even while the abuser is being
+    shed), the abusive tenant gets 429s, and
+    ``serve.admission{result=shed}`` is booked."""
+    import json
+    import threading as _threading
+    import urllib.request
+
+    from disq_tpu import (
+        BaiWriteOption, DisqOptions, ReadsStorage, TraversalParameters)
+    from disq_tpu.api import Interval
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        FaultSpec,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+    from disq_tpu.runtime import serve as serve_mod
+    from disq_tpu.runtime.introspect import stop_introspect_server
+    from disq_tpu.runtime.tracing import counter
+
+    indexed = os.path.join(tmp, "serve-indexed.bam")
+    st = ReadsStorage.make_default().num_shards(4)
+    st.write(st.read(path), indexed, BaiWriteOption.ENABLE, sort=True)
+
+    regions = [("chr1", 1, 5000), ("chr1", 40_000, 60_000),
+               ("chr2", 1, 50_000), ("chrM", 1, 16_569)]
+    truth = {}
+    for contig, start, end in regions:
+        ds = ReadsStorage.make_default().read(
+            indexed,
+            TraversalParameters(intervals=[Interval(contig, start, end)]))
+        truth[(contig, start, end)] = ds.count()
+
+    register_filesystem("fault", FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(),
+        [FaultSpec(kind="transient", probability=0.15)], seed=77))
+    try:
+        addr = serve_mod.start_serve(
+            options=DisqOptions(max_retries=8, retry_backoff_s=0.0),
+            tenant_slots=2, tenant_queue=2)
+        daemon = serve_mod.serve_if_running()
+        daemon.register("soak", "fault://" + indexed)
+
+        def query(tenant, region, timeout=30):
+            contig, start, end = region
+            body = json.dumps({
+                "dataset": "soak", "tenant": tenant, "limit": 0,
+                "intervals": [
+                    {"contig": contig, "start": start, "end": end}],
+            }).encode()
+            req = urllib.request.Request(
+                f"http://{addr}/query/reads", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"{}")
+
+        # Warm the header+index cache (the index build path is not
+        # retried; a transient during warm-up just retries the query).
+        warm_err = None
+        for _ in range(8):
+            code, body = query("warm", regions[0])
+            if code == 200:
+                warm_err = None
+                break
+            warm_err = f"warm-up answered {code}: {body}"
+        if warm_err:
+            return f"serve: {warm_err}"
+        daemon.cache.clear()  # the storm must fetch through the faults
+
+        # Good tenants: all queries must succeed with truthful counts.
+        errors = []
+
+        def good(k):
+            tenant = f"good-{k}"
+            for region in regions:
+                code, body = query(tenant, region)
+                if code != 200:
+                    errors.append(
+                        f"tenant {tenant} got {code} for {region}: "
+                        f"{body.get('error')}")
+                elif body["count"] != truth[region]:
+                    errors.append(
+                        f"tenant {tenant} count {body['count']} != "
+                        f"truth {truth[region]} for {region}")
+
+        threads = [_threading.Thread(target=good, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            return "serve: " + "; ".join(errors[:3])
+
+        # Abusive tenant: pin the storm's worst case deterministically —
+        # occupy both of the abuser's slots and park two more acquires
+        # in its 2-deep wait queue through the daemon's admission
+        # object, then every further HTTP request from that tenant MUST
+        # shed with 429 while the good tenants' own slots are untouched.
+        import time as _time
+
+        adm = daemon.admission
+        for _ in range(2):
+            adm.acquire("abuser")
+        parked = [_threading.Thread(target=adm.acquire, args=("abuser",))
+                  for _ in range(2)]
+        for t in parked:
+            t.start()
+        deadline = _time.time() + 10.0
+        while _time.time() < deadline:
+            ten = adm.stats()["tenants"].get("abuser", {})
+            if ten.get("queued", 0) >= 2:
+                break
+            _time.sleep(0.01)
+        try:
+            codes = [query("abuser", regions[2])[0] for _ in range(8)]
+            good_code, good_body = query("good-0", regions[0])
+        finally:
+            for _ in range(2):
+                adm.release("abuser")
+            for t in parked:
+                t.join()
+            for _ in range(2):
+                adm.release("abuser")
+        shed_seen = codes.count(429)
+        if shed_seen != len(codes):
+            return (f"serve: abuser with full slots+queue answered "
+                    f"{codes}, expected all 429")
+        if good_code != 200 or good_body["count"] != truth[regions[0]]:
+            return (f"serve: good tenant degraded during the abuser "
+                    f"storm ({good_code}, {good_body.get('count')})")
+        if counter("serve.admission").value(
+                result="shed", tenant="abuser") <= 0:
+            return ("serve: 429s answered but serve.admission"
+                    "{result=shed,tenant=abuser} not booked")
+        return ""
+    finally:
+        serve_mod.stop_serve()
+        stop_introspect_server()
+        register_filesystem("fault", FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(), [], seed=0))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--iterations", type=int, default=20)
@@ -869,6 +1021,14 @@ def main(argv=None) -> int:
                          "lease to the fast worker, emit every shard "
                          "exactly once, and match a fault-free "
                          "single-host read digest for digest")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving-plane leg: a tenant storm "
+                         "(four good tenants + one abusive 16-way "
+                         "burst) through injected transient read "
+                         "faults; good tenants' region queries must "
+                         "all succeed with truthful counts, the "
+                         "abusive tenant must shed with 429s, and "
+                         "serve.admission{result=shed} must be booked")
     ap.add_argument("--kill", action="store_true",
                     help="run the crash-resume leg: SIGKILL a writer "
                          "subprocess mid-run, resume from its "
@@ -936,6 +1096,11 @@ def main(argv=None) -> int:
         if args.kill:
             err = kill_leg(path, tmp)
             print(f"[kill] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.serve:
+            err = serve_leg(path, tmp)
+            print(f"[serve] {'ok' if not err else 'FAIL: ' + err}")
             if err:
                 failures.append((args.seed, err))
         print(f"{len(failures)} mismatches in {args.iterations} iterations")

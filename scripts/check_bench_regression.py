@@ -46,7 +46,13 @@ from typing import Any, Dict, List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Leaf keys that mean "bigger is better, guard me".
-THROUGHPUT_KEYS = ("records_per_sec", "mb_per_sec", "staged_records_per_sec")
+THROUGHPUT_KEYS = ("records_per_sec", "mb_per_sec", "staged_records_per_sec",
+                   "qps")
+
+# Leaf keys that mean "smaller is better, guard me" — the serving
+# plane's latency series (config 13): a p99 RISE past the band fails,
+# a drop is an improvement.
+LATENCY_KEYS = ("p99_ms",)
 
 # Per-config BASE tolerance overrides, matched by series-path prefix
 # (the --tolerance default applies elsewhere). Config 10 measures the
@@ -65,6 +71,9 @@ CONFIG_TOLERANCE = {
     # OS-scheduler-dependent steal timing — the widest legitimate
     # run-to-run wobble in the matrix.
     "12_sched_steal": 0.40,
+    # Config 13 measures closed-loop request latency percentiles —
+    # tail latency wobbles more run-to-run than throughput medians.
+    "13_serve_latency": 0.25,
 }
 
 
@@ -78,7 +87,13 @@ SPREAD_OF = {
     "records_per_sec": "spread",
     "mb_per_sec": "spread",
     "staged_records_per_sec": "staged_spread",
+    "qps": "qps_spread",
+    "p99_ms": "spread",
 }
+
+
+def lower_is_better(path: str) -> bool:
+    return path.rsplit(".", 1)[-1] in LATENCY_KEYS
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -135,7 +150,8 @@ def extract_series(configs: Dict[str, Any]) -> Dict[str, Tuple[float, float]]:
             path = f"{prefix}.{key}" if prefix else key
             if isinstance(val, dict):
                 walk(val, path)
-            elif key in THROUGHPUT_KEYS and isinstance(val, (int, float)):
+            elif (key in THROUGHPUT_KEYS or key in LATENCY_KEYS) \
+                    and isinstance(val, (int, float)):
                 spread = node.get(SPREAD_OF[key], 0.0)
                 if not isinstance(spread, (int, float)):
                     spread = 0.0
@@ -165,10 +181,16 @@ def compare(prev: Dict[str, Tuple[float, float]],
         nv, ns = new[path]
         if pv <= 0:
             continue
-        drop = 1.0 - nv / pv
+        # "drop" is signed toward worse: a throughput fall or a
+        # latency rise; either fails when it exceeds the band.
+        if lower_is_better(path):
+            drop = nv / pv - 1.0
+        else:
+            drop = 1.0 - nv / pv
         band = base_tolerance(path, tolerance) + max(ps, ns)
+        sign = 1.0 if lower_is_better(path) else -1.0
         line = (f"{path}: {pv:,.1f} -> {nv:,.1f} "
-                f"({-drop * 100:+.1f}%, band ±{band * 100:.1f}%)")
+                f"({sign * drop * 100:+.1f}%, band ±{band * 100:.1f}%)")
         if drop > band:
             failures.append(line)
         else:

@@ -65,6 +65,10 @@ ALLOWED_PREFIXES = {
     # lease/steal/locality accounting, membership gauge, worker RPC
     # spans.
     "sched",
+    # Serving plane (runtime/serve.py): request latency histograms,
+    # two-tier hot-block cache accounting, index-cache hit/miss, and
+    # per-tenant admission results + queue-wait spans.
+    "serve",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

@@ -84,7 +84,7 @@ def main() -> int:
         t.name for t in threading.enumerate()
         if t.name.startswith(
             ("disq-watchdog", "disq-introspect", "disq-device",
-             "disq-hostwork", "disq-profiler"))
+             "disq-hostwork", "disq-profiler", "disq-serve"))
     ]
     if bad_threads:
         errors.append(f"stray observability threads: {bad_threads}")
@@ -165,6 +165,23 @@ def main() -> int:
            for t in threading.enumerate()):
         errors.append(
             "stray scheduler thread on the disabled path")
+
+    # -- 1b4. serving plane: off ⇒ no daemon, caches or admission state ------
+    from disq_tpu.runtime import serve as serve_plane
+
+    if serve_plane.serve_if_running() is not None:
+        errors.append(
+            "a serve daemon exists with no serve() call — the serve-off "
+            "path must hold no registry, cache or admission state")
+    code, _body = serve_plane.handle_http("POST", "/query/reads", {})
+    if code != 503:
+        errors.append(
+            f"serve.handle_http answered {code} with no daemon running "
+            "— the serve-off path must 503 without serving")
+    if serve_plane.serve_if_running() is not None:
+        errors.append(
+            "handle_http on the serve-off path allocated the daemon — "
+            "only start_serve() may create caches/admission state")
 
     # -- 1c. resident decode: disabled ⇒ no ColumnarBatch device builds ------
     from disq_tpu.runtime import columnar

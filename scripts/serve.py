@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""serve — run the multi-tenant interval-query daemon from the shell.
+
+Registers the given datasets and serves region queries over HTTP until
+interrupted (see ``runtime/serve.py`` and the README "Serving plane"
+section for the endpoint table and QoS semantics)::
+
+    python scripts/serve.py --port 8765 \
+        --dataset wgs=/data/sample.bam \
+        --dataset calls=/data/sample.vcf.gz
+
+    curl -s -XPOST localhost:8765/query/reads -d '{
+        "dataset": "wgs", "tenant": "alice",
+        "intervals": [{"contig": "chr1", "start": 1, "end": 100000}]}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serve interval queries over registered datasets")
+    ap.add_argument("--port", type=int, default=0,
+                    help="HTTP port (default: ephemeral, printed)")
+    ap.add_argument("--dataset", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="register a dataset (repeatable); kind is "
+                         "sniffed from the extension")
+    ap.add_argument("--tenant-slots", type=int, default=None,
+                    help="concurrent requests per tenant")
+    ap.add_argument("--tenant-queue", type=int, default=None,
+                    help="queued requests per tenant before 429")
+    ap.add_argument("--compressed-cache-mb", type=int, default=None,
+                    help="compressed hot-block tier budget")
+    ap.add_argument("--decoded-cache-mb", type=int, default=None,
+                    help="decoded hot-block tier budget")
+    ap.add_argument("--parsed-cache-mb", type=int, default=None,
+                    help="parsed chunk-batch tier budget")
+    args = ap.parse_args(argv)
+
+    datasets = {}
+    for spec in args.dataset:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            ap.error(f"--dataset wants NAME=PATH, got {spec!r}")
+        datasets[name] = path
+
+    from disq_tpu.api import serve
+
+    handle = serve(
+        datasets, port=args.port,
+        tenant_slots=args.tenant_slots, tenant_queue=args.tenant_queue,
+        compressed_cache_mb=args.compressed_cache_mb,
+        decoded_cache_mb=args.decoded_cache_mb,
+        parsed_cache_mb=args.parsed_cache_mb)
+    names = ", ".join(datasets) or "none (POST /serve/register)"
+    print(f"serving on http://{handle.address}  (datasets: {names})",
+          flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,10 @@ CATEGORIES = (
     # the idle wait between empty lease rounds, and steal attempts —
     # the coordination cost of the distributed data plane.
     ("sched", "L", ("sched.",)),
+    # Serving-plane admission queue (runtime/serve.py): a request
+    # parked waiting for one of its tenant's concurrency slots — queue
+    # time the QoS knobs (not a pipeline stage) control.
+    ("serve_queue", "A", ("serve.admission.wait",)),
     ("emit_stall", "s", ("executor.emit.stall", "writer.emit.stall")),
     ("retry", "r", ("retry.",)),
     ("quarantine", "q", ("quarantine.",)),
@@ -362,7 +366,10 @@ WORK_PRIORITY = ("device", "transfer", "device_write", "columnar",
                  # RPC rounds only win instants where no stage runs,
                  # and steal/idle-wait time is by definition a worker
                  # with nothing to do
-                 "sched", "steal")
+                 "sched", "steal",
+                 # admission-queue wait ranks last: a parked request
+                 # only wins instants where nothing else progresses
+                 "serve_queue")
 
 ADVICE = {
     "fetch": "I/O-bound range reads: raise executor_workers / "
@@ -415,6 +422,12 @@ ADVICE = {
              "stragglers hold fewer shards at a time, lower "
              "sched_lease_s so a dead host's leases requeue sooner, "
              "or check the victim host named in sched.steals{victim=}",
+    "serve_queue": "admission-queue wait dominates: requests sit "
+                   "parked for tenant slots — raise tenant_slots (or "
+                   "spread load across tenants), or lower tenant_queue "
+                   "so excess load sheds with 429 instead of burning "
+                   "p99 in the queue; serve.admission{tenant=} names "
+                   "who is queuing",
 }
 
 

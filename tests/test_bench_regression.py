@@ -111,6 +111,60 @@ def test_staged_rows_use_their_own_spread_key(cbr, tmp_path):
         90_000.0, 0.3)
 
 
+def _serve_cfg(p99, spread=0.02, qps=2000.0):
+    """Config-13-shaped row: hot latency percentiles + QPS at c=32."""
+    return {"13_serve_latency": {"clients_32": {
+        "cold_p99_ms": 500.0,
+        "hot": {"p50_ms": p99 / 4, "p99_ms": p99, "p999_ms": p99 * 1.5,
+                "spread": spread, "qps": qps, "qps_spread": 0.03},
+    }}}
+
+
+def test_serve_latency_is_lower_is_better(cbr, tmp_path):
+    """Satellite: a +30% hot p99 at c=32 must FAIL even though every
+    other guarded series is higher-is-better."""
+    _round(tmp_path, 1, configs=_serve_cfg(40.0))
+    _round(tmp_path, 2, configs=_serve_cfg(52.0))  # +30% > 25% + 2%
+    rc = cbr.main(["--dir", str(tmp_path)])
+    assert rc == 1
+
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "13_serve_latency.clients_32.hot.p99_ms" in proc.stdout
+
+
+def test_serve_latency_improvement_passes(cbr, tmp_path):
+    """A lower p99 is an improvement, never a 'drop'."""
+    _round(tmp_path, 1, configs=_serve_cfg(40.0))
+    _round(tmp_path, 2, configs=_serve_cfg(8.0))  # 5x better
+    assert cbr.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_serve_latency_within_band_passes(cbr, tmp_path):
+    _round(tmp_path, 1, configs=_serve_cfg(40.0))
+    _round(tmp_path, 2, configs=_serve_cfg(44.0))  # +10% < 25% band
+    assert cbr.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_serve_qps_drop_fails_higher_is_better(cbr, tmp_path):
+    """The same config's QPS row keeps the higher-is-better sense."""
+    _round(tmp_path, 1, configs=_serve_cfg(40.0, qps=2000.0))
+    _round(tmp_path, 2, configs=_serve_cfg(40.0, qps=1000.0))
+    rc = cbr.main(["--dir", str(tmp_path)])
+    assert rc == 1
+
+
+def test_cold_percentiles_are_not_guarded(cbr, tmp_path):
+    """Cold numbers are context (first-touch, dominated by one-off
+    I/O), not a guarded series — only leaf ``p99_ms`` keys are."""
+    series = cbr.extract_series(_serve_cfg(40.0))
+    assert "13_serve_latency.clients_32.hot.p99_ms" in series
+    assert series["13_serve_latency.clients_32.hot.p99_ms"] == (40.0, 0.02)
+    assert not any("cold" in k for k in series)
+
+
 def test_new_and_retired_configs_never_fail(cbr, tmp_path):
     _round(tmp_path, 1, configs={"old": {"records_per_sec": 1000.0}})
     _round(tmp_path, 2, configs={"new": {"records_per_sec": 5.0}})

@@ -290,10 +290,13 @@ def test_chaos_soak_smoke(executor_workers):
     after d2h against the host path), --device-write (resident encode
     + service-routed SIMD deflate under write faults, record-compared
     after re-read against the fault-free host path), and --kill
-    (SIGKILL a writer mid-run, ledger-asserted resume), and --steal
+    (SIGKILL a writer mid-run, ledger-asserted resume), --steal
     (2-subprocess scheduled read with one slowed worker: the fast
     worker must steal a stale lease, every shard emits exactly once,
-    digests match a single-host read)."""
+    digests match a single-host read), and --serve (tenant storm
+    against the serving plane under transient read faults: good
+    tenants succeed with truthful counts, the abusive tenant sheds
+    with 429s and serve.admission{result=shed} is booked)."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
@@ -302,7 +305,7 @@ def test_chaos_soak_smoke(executor_workers):
          "--seed", "7", "--executor-workers", str(executor_workers),
          "--writer-workers", str(executor_workers),
          "--hedge", "--breaker", "--resident", "--device-write",
-         "--steal", "--kill"]
+         "--steal", "--kill", "--serve"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},

@@ -1,0 +1,413 @@
+"""Serving-plane tests — multi-tenant interval-query daemon
+(``runtime/serve.py``): endpoint correctness against the direct
+traversal path, the shared hot-block cache, header/index LRU
+invalidation, per-tenant admission control, and cross-client identity
+with the device decode service off and on.
+"""
+
+import json
+import os
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from disq_tpu import BaiWriteOption, ReadsStorage, SbiWriteOption, TraversalParameters
+from disq_tpu.api import Interval
+from disq_tpu.runtime import serve as serve_mod
+from disq_tpu.runtime.introspect import stop_introspect_server
+
+from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
+
+REGIONS = [
+    ("chr1", 1, 5000),
+    ("chr1", 40_000, 60_000),
+    ("chr2", 1, 50_000),
+    ("chrM", 1, 16_569),
+]
+
+
+@pytest.fixture(scope="module")
+def indexed_bam(tmp_path_factory):
+    records = synth_records(1500, seed=23, unmapped_tail=0)
+    raw = str(tmp_path_factory.mktemp("serve") / "raw.bam")
+    with open(raw, "wb") as f:
+        f.write(make_bam_bytes(DEFAULT_REFS, records, blocksize=700))
+    storage = ReadsStorage.make_default().num_shards(4)
+    ds = storage.read(raw)
+    out = str(tmp_path_factory.mktemp("serve") / "sorted.bam")
+    storage.write(ds, out, BaiWriteOption.ENABLE, SbiWriteOption.ENABLE,
+                  sort=True)
+    return out
+
+
+@pytest.fixture()
+def daemon(indexed_bam):
+    """A running daemon with the module BAM registered as ``reads``."""
+    addr = serve_mod.start_serve(port=0, tenant_slots=8, tenant_queue=32)
+    d = serve_mod.serve_if_running()
+    d.register("reads", indexed_bam)
+    try:
+        yield d, addr
+    finally:
+        serve_mod.stop_serve()
+        stop_introspect_server()
+
+
+def _post(addr, path, doc, timeout=30):
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _truth_count(path, contig, start, end):
+    ds = ReadsStorage.make_default().read(
+        path, TraversalParameters(intervals=[Interval(contig, start, end)]))
+    return int(ds.reads.count)
+
+
+def _q(contig, start, end, tenant="t0", **kw):
+    doc = {"dataset": "reads", "tenant": tenant,
+           "intervals": [{"contig": contig, "start": start, "end": end}]}
+    doc.update(kw)
+    return doc
+
+
+class TestEndpoints:
+    @pytest.mark.parametrize("contig,start,end", REGIONS)
+    def test_reads_count_matches_traversal(self, daemon, indexed_bam,
+                                           contig, start, end):
+        _, addr = daemon
+        status, out = _post(addr, "/query/reads", _q(contig, start, end))
+        assert status == 200
+        assert out["count"] == _truth_count(indexed_bam, contig, start, end)
+        # default limit caps the inline records, count stays exact
+        assert len(out["records"]) == min(out["count"], 100)
+        for r in out["records"]:
+            assert r["contig"] == contig
+
+    def test_count_only_fast_path_matches(self, daemon, indexed_bam):
+        _, addr = daemon
+        contig, start, end = REGIONS[1]
+        _, full = _post(addr, "/query/reads", _q(contig, start, end))
+        status, fast = _post(addr, "/query/reads",
+                             _q(contig, start, end, limit=0, digest=False))
+        assert status == 200
+        assert fast["count"] == full["count"]
+        assert fast["records"] == []
+        assert "digest" not in fast and "digest" in full
+
+    def test_stats_flagstat_and_depth(self, daemon, indexed_bam):
+        _, addr = daemon
+        contig, start, end = REGIONS[0]
+        status, out = _post(addr, "/query/stats",
+                            _q(contig, start, end, stat="flagstat"))
+        assert status == 200
+        assert out["flagstat"]["total"] == _truth_count(
+            indexed_bam, contig, start, end)
+        status, out = _post(addr, "/query/stats",
+                            _q(contig, start, end, stat="depth", window=512))
+        assert status == 200
+        assert out["depth"]["window"] == 512
+        assert out["depth"]["refs"]["chr1"]["total"] >= out["count"]
+
+    def test_serve_stats_shape(self, daemon):
+        _, addr = daemon
+        _post(addr, "/query/reads", _q(*REGIONS[0]))
+        with urllib.request.urlopen(f"http://{addr}/serve/stats",
+                                    timeout=30) as r:
+            st = json.loads(r.read())
+        assert {"datasets", "cache", "index_cache", "admission",
+                "latency"} <= set(st)
+        assert [d["name"] for d in st["datasets"]] == ["reads"]
+        for tier in ("compressed", "decoded", "parsed"):
+            assert st["cache"][tier]["bytes"] >= 0
+        assert st["admission"]["slots"] == 8
+
+    def test_errors(self, daemon):
+        _, addr = daemon
+        status, out = _post(addr, "/query/reads",
+                            _q(*REGIONS[0], dataset="nope"))
+        assert status == 404
+        status, out = _post(addr, "/query/reads", {"tenant": "x"})
+        assert status == 400
+        status, out = _post(
+            addr, "/query/stats", _q(*REGIONS[0], stat="bogus"))
+        assert status == 400
+
+    def test_handle_http_503_when_off(self):
+        assert serve_mod.serve_if_running() is None
+        status, out = serve_mod.handle_http("POST", "/query/reads", {})
+        assert status == 503
+        assert "serve" in out["error"]
+
+    def test_serve_metrics_exposed(self, daemon):
+        _, addr = daemon
+        _post(addr, "/query/reads", _q(*REGIONS[0]))
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=30) as r:
+            body = r.read().decode()
+        for name in ("serve_request", "serve_cache_misses",
+                     "serve_admission"):
+            assert name in body
+
+
+class TestHotBlockCache:
+    def test_repeat_query_hits_parsed_tier(self, daemon):
+        d, addr = daemon
+        from disq_tpu.runtime.tracing import counter
+
+        _, first = _post(addr, "/query/reads", _q(*REGIONS[2]))
+        hits0 = counter("serve.cache.hits").total()
+        _, second = _post(addr, "/query/reads", _q(*REGIONS[2]))
+        assert second["digest"] == first["digest"]
+        assert second["count"] == first["count"]
+        assert counter("serve.cache.hits").total() > hits0
+        st = d.cache.stats()
+        assert st["parsed"]["blocks"] > 0
+        assert st["parsed"]["tenant_bytes"]["t0"] > 0
+
+    def test_eviction_under_byte_budget(self, daemon):
+        d, _ = daemon
+        from disq_tpu.runtime.tracing import counter
+
+        ev0 = counter("serve.cache.evictions").total()
+        cache = serve_mod.HotBlockCache(
+            compressed_bytes=1 << 12, decoded_bytes=1 << 12,
+            parsed_bytes=1 << 12)
+        for i in range(8):
+            cache.put("decoded", "p", i, b"x" * 1024, 1024, "t")
+        st = cache.stats()
+        assert st["decoded"]["bytes"] <= 1 << 12
+        assert counter("serve.cache.evictions").total() > ev0
+        # evicted key misses, resident key hits
+        assert cache.get("decoded", "p", 0, "t") is None
+        assert cache.get("decoded", "p", 7, "t") == b"x" * 1024
+
+    def test_clear_empties_every_tier(self, daemon):
+        d, addr = daemon
+        _post(addr, "/query/reads", _q(*REGIONS[0]))
+        d.cache.clear()
+        st = d.cache.stats()
+        for tier in serve_mod.HotBlockCache.TIERS:
+            assert st[tier]["blocks"] == 0
+            assert st[tier]["bytes"] == 0
+
+
+class TestIndexCache:
+    def test_mtime_size_invalidation(self, tmp_path, daemon):
+        d, addr = daemon
+        from disq_tpu.runtime.tracing import counter
+
+        p = str(tmp_path / "swap.bam")
+        storage = ReadsStorage.make_default().num_shards(2)
+
+        def write_n(n, seed):
+            raw = str(tmp_path / "raw.bam")
+            with open(raw, "wb") as f:
+                f.write(make_bam_bytes(
+                    DEFAULT_REFS, synth_records(n, seed=seed), blocksize=700))
+            storage.write(storage.read(raw), p,
+                          BaiWriteOption.ENABLE, SbiWriteOption.ENABLE,
+                          sort=True)
+
+        write_n(200, seed=1)
+        d.register("swap", p)
+        doc = _q("chr1", 1, 200_000, dataset="swap", digest=False, limit=0)
+        _, out1 = _post(addr, "/query/reads", doc)
+        misses1 = counter("serve.index_cache.misses").total()
+        _, again = _post(addr, "/query/reads", doc)
+        assert again["count"] == out1["count"]
+        # warm re-query parses nothing new
+        assert counter("serve.index_cache.misses").total() == misses1
+        hits = counter("serve.index_cache.hits").total()
+        assert hits > 0
+
+        # rewrite the file in place: (size, mtime) changes, entry drops
+        write_n(400, seed=2)
+        d.cache.clear()
+        _, out2 = _post(addr, "/query/reads", doc)
+        assert counter("serve.index_cache.misses").total() > misses1
+        assert out2["count"] != out1["count"]
+        assert out2["count"] == _truth_count(p, "chr1", 1, 200_000)
+
+    def test_lru_capacity_bound(self):
+        ic = serve_mod.IndexCache(entries=2)
+        calls = []
+
+        class _FS:
+            def get_file_length(self, path):
+                return 1
+
+        def build(fs, path):
+            calls.append(path)
+            return path.upper()
+
+        fs = _FS()
+        for p in ("a", "b", "c", "a"):
+            ic.get(fs, p, build)
+        # "a" was evicted by "c" (capacity 2) and rebuilt
+        assert calls == ["a", "b", "c", "a"]
+
+
+class TestAdmission:
+    def test_deterministic_shed(self):
+        adm = serve_mod.TenantAdmission(slots=1, queue_depth=0)
+        from disq_tpu.runtime.tracing import counter
+
+        shed0 = counter("serve.admission").value(result="shed",
+                                                 tenant="noisy")
+        adm.acquire("noisy")
+        with pytest.raises(serve_mod.AdmissionShed):
+            adm.acquire("noisy")
+        assert counter("serve.admission").value(
+            result="shed", tenant="noisy") == shed0 + 1
+        # other tenants are unaffected
+        adm.acquire("polite")
+        adm.release("polite")
+        adm.release("noisy")
+        adm.acquire("noisy")
+        adm.release("noisy")
+
+    def test_queue_then_release(self):
+        adm = serve_mod.TenantAdmission(slots=1, queue_depth=4)
+        adm.acquire("t")
+        got = []
+
+        def waiter():
+            adm.acquire("t")
+            got.append(1)
+            adm.release("t")
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        deadline = 50
+        while adm.stats()["tenants"].get("t", {}).get("queued", 0) < 1 \
+                and deadline:
+            deadline -= 1
+            threading.Event().wait(0.01)
+        adm.release("t")
+        th.join(timeout=10)
+        assert got == [1]
+
+    def test_http_429_when_pinned(self, daemon):
+        d, addr = daemon
+        adm = d.admission
+        # pin every slot and the whole queue by hand — deterministic
+        for _ in range(8):
+            adm.acquire("pig")
+
+        def parked():
+            try:
+                adm.acquire("pig")
+            except serve_mod.AdmissionShed:
+                return
+            adm.release("pig")
+
+        waiters = [threading.Thread(target=parked) for _ in range(32)]
+        for t in waiters:
+            t.start()
+        spins = 500
+        while spins and adm.stats()["tenants"]["pig"]["queued"] < 32:
+            spins -= 1
+            threading.Event().wait(0.01)
+        try:
+            status, out = _post(addr, "/query/reads",
+                                _q(*REGIONS[0], tenant="pig"))
+            assert status == 429
+            # a different tenant sails through
+            status2, _ = _post(addr, "/query/reads",
+                               _q(*REGIONS[0], tenant="calm"))
+            assert status2 == 200
+        finally:
+            # freeing the slots lets the parked waiters drain themselves
+            for _ in range(8):
+                adm.release("pig")
+            for t in waiters:
+                t.join(timeout=30)
+
+
+class TestConcurrencyIdentity:
+    """Satellite: N threads issuing overlapping region queries get
+    byte-identical answers to serial reads — device service off and on."""
+
+    def _run_identity(self, daemon, dataset, n_threads=16, passes=2,
+                      timeout=30):
+        d, addr = daemon
+        serial = {}
+        for i in range(len(REGIONS)):
+            contig, start, end = REGIONS[i]
+            status, doc = _post(
+                addr, "/query/reads",
+                _q(contig, start, end, tenant="s", dataset=dataset),
+                timeout=timeout)
+            assert status == 200, doc
+            serial[i] = doc["digest"]
+        d.cache.clear()
+
+        results = [None] * n_threads
+        errors = []
+
+        def client(k, order):
+            try:
+                for i in order:
+                    contig, start, end = REGIONS[i]
+                    status, doc = _post(
+                        addr, "/query/reads",
+                        _q(contig, start, end, tenant=f"t{k % 4}",
+                           dataset=dataset),
+                        timeout=timeout)
+                    assert status == 200, doc
+                    assert doc["digest"] == serial[i], (k, i)
+                results[k] = True
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append((k, repr(e)))
+
+        threads = []
+        for k in range(n_threads):
+            order = list(range(len(REGIONS))) * passes
+            random.Random(k).shuffle(order)
+            threads.append(threading.Thread(target=client,
+                                            args=(k, order)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not errors, errors
+        assert all(results)
+
+    def test_identity_host_zlib(self, daemon, monkeypatch):
+        monkeypatch.delenv("DISQ_TPU_DEVICE_SERVICE", raising=False)
+        self._run_identity(daemon, "reads")
+
+    @pytest.mark.slow
+    def test_identity_device_service(self, daemon, tmp_path, monkeypatch):
+        """Same identity contract through the device decode service —
+        a tiny BAM keeps interpret-mode inflate tractable on a host
+        backend; on a real chip the same path runs the SIMD kernel."""
+        from disq_tpu.runtime import device_service
+
+        raw = str(tmp_path / "tiny-raw.bam")
+        with open(raw, "wb") as f:
+            f.write(make_bam_bytes(
+                DEFAULT_REFS, synth_records(120, seed=5),
+                blocksize=4096))
+        storage = ReadsStorage.make_default().num_shards(2)
+        tiny = str(tmp_path / "tiny.bam")
+        storage.write(storage.read(raw), tiny, BaiWriteOption.ENABLE,
+                      SbiWriteOption.ENABLE, sort=True)
+        d, _addr = daemon
+        d.register("tiny", tiny)
+
+        monkeypatch.setenv("DISQ_TPU_DEVICE_SERVICE", "1")
+        try:
+            self._run_identity(daemon, "tiny", n_threads=4, passes=1,
+                               timeout=300)
+        finally:
+            device_service.shutdown_service()
