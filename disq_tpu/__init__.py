@@ -48,6 +48,7 @@ from disq_tpu.runtime import (  # noqa: F401
     BreakerOpenError,
     ClusterAggregator,
     ColumnarBatch,
+    CoordinatorLostError,
     CorruptBlockError,
     DeadlineExceededError,
     DisqOptions,

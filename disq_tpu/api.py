@@ -494,20 +494,29 @@ class ReadsStorage:
 
     def scheduler(self, mode: str, lease_n: int = 2,
                   lease_s: float = 10.0,
-                  steal: bool = True) -> "ReadsStorage":
+                  steal: bool = True,
+                  run_weight: float = 1.0,
+                  failover_dir: Optional[str] = None) -> "ReadsStorage":
         """Join this storage's reads to the cross-host shard scheduler
         (``runtime/scheduler.py``): ``mode="serve"`` hosts the shared
         work-queue coordinator on this process's introspection endpoint
-        (and works); ``mode="host:port"`` joins that coordinator.
-        Workers lease ``lease_n`` shards at a time (locality-routed to
-        the host whose HTTP block cache holds their byte range), a
-        lease unfinished after ``lease_s`` seconds is re-queued (the
-        crash-handoff latency), and ``steal`` lets an idle worker take
-        stale leases from the most-loaded host.  Env equivalents:
-        ``DISQ_TPU_SCHED`` / ``DISQ_TPU_SCHED_LEASE_N`` /
-        ``DISQ_TPU_SCHED_LEASE_S`` / ``DISQ_TPU_SCHED_STEAL``."""
+        (and works); ``mode="host:port"`` joins that coordinator;
+        ``mode="auto"`` discovers the coordinator through
+        ``failover_dir``.  Workers lease ``lease_n`` shards at a time
+        (locality-routed to the host whose HTTP block cache holds their
+        byte range), a lease unfinished after ``lease_s`` seconds is
+        re-queued (the crash-handoff latency), and ``steal`` lets an
+        idle worker take stale leases from the most-loaded host.
+        ``run_weight`` is this run's share in the coordinator's
+        weighted max-min lease fairness (contended coordinators only);
+        ``failover_dir`` arms coordinator failover — the coordinator
+        journals every transition there and, on its death, the lowest
+        live member replays the journal and resumes the pass.  Env
+        equivalents: ``DISQ_TPU_SCHED`` / ``DISQ_TPU_SCHED_LEASE_N`` /
+        ``DISQ_TPU_SCHED_LEASE_S`` / ``DISQ_TPU_SCHED_STEAL`` /
+        ``DISQ_TPU_SCHED_WEIGHT`` / ``DISQ_TPU_SCHED_FAILOVER``."""
         self._options = self._options.with_scheduler(
-            mode, lease_n, lease_s, steal)
+            mode, lease_n, lease_s, steal, run_weight, failover_dir)
         return self
 
     def http_cache_blocks(self, n: int) -> "ReadsStorage":
@@ -723,13 +732,16 @@ class VariantsStorage:
 
     def scheduler(self, mode: str, lease_n: int = 2,
                   lease_s: float = 10.0,
-                  steal: bool = True) -> "VariantsStorage":
+                  steal: bool = True,
+                  run_weight: float = 1.0,
+                  failover_dir: Optional[str] = None
+                  ) -> "VariantsStorage":
         """See ``ReadsStorage.scheduler``.  VCF reads lease their
         splits from the shared queue; BCF keeps the static whole-file
         path (its single BGZF stream cannot be partitioned across
         hosts) exactly as it keeps strict deadline semantics."""
         self._options = self._options.with_scheduler(
-            mode, lease_n, lease_s, steal)
+            mode, lease_n, lease_s, steal, run_weight, failover_dir)
         return self
 
     def http_cache_blocks(self, n: int) -> "VariantsStorage":

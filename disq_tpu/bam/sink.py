@@ -384,7 +384,10 @@ class BamSink:
                     write_bai, write_sbi, k, resident=resident)
         try:
             with trace_phase("bam.write.parts"):
-                if manifest is not None and pipeline.workers == 1:
+                from disq_tpu.runtime.scheduler import write_leasing_armed
+
+                if (manifest is not None and pipeline.workers == 1
+                        and not write_leasing_armed(self._storage)):
                     # Historical sequential-checkpoint path: run_stage
                     # owns skip/retry/RuntimeError semantics per shard.
                     infos = manifest.run_stage(
@@ -397,6 +400,7 @@ class BamSink:
                             write_bai, write_sbi, k, frag_cache,
                             resident),
                         manifest=manifest, stage_name="bam.parts",
+                        storage=self._storage, path=path,
                     )
         finally:
             if resident is not None:
@@ -496,5 +500,7 @@ class BamSinkMultiple:
                 what="bam.part",
             )
 
+        # no manifest ⇒ no durable side: the write-leasing path stays
+        # off for directory-of-BAMs saves regardless of scheduler mode
         run_write_stage(writer_for_storage(self._storage), n_shards,
-                        make_task)
+                        make_task, storage=self._storage, path=path)

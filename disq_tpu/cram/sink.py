@@ -81,7 +81,11 @@ def run_cram_write_stage(storage, fs, batch, bounds, n_shards, ref_fetch,
             what="cram.part",
         )
 
-    return run_write_stage(writer_for_storage(storage), n_shards, make_task)
+    # storage+path wired through for the scheduler's write-direction
+    # leasing gate (inert here: no StageManifest rides along)
+    return run_write_stage(writer_for_storage(storage), n_shards,
+                           make_task, storage=storage,
+                           path=part_path_for(0))
 
 
 def _header_container(header) -> bytes:

@@ -83,6 +83,7 @@ from disq_tpu.runtime.counters import (  # noqa: F401
 )
 from disq_tpu.runtime.errors import (  # noqa: F401
     BreakerOpenError,
+    CoordinatorLostError,
     CorruptBlockError,
     DeadlineExceededError,
     DisqOptions,

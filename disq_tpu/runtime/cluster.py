@@ -101,6 +101,27 @@ def _kind_of(sample_name: str, kinds: Dict[str, str]) -> str:
     return "untyped"
 
 
+def probe_liveness(endpoints: Sequence[str],
+                   timeout_s: float = 1.0) -> Dict[str, bool]:
+    """One-shot reachability probe of introspection endpoints — the
+    scheduler failover election's view of "live" (``scheduler.py``
+    standby election picks the lowest live member).  Hits ``/healthz``
+    with a short timeout; a 503 (degraded) still counts as *alive* —
+    election needs "is the process up", not "is it healthy"."""
+    alive: Dict[str, bool] = {}
+    for endpoint in endpoints:
+        base = endpoint if "://" in endpoint else "http://" + endpoint
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=timeout_s):
+                alive[endpoint] = True
+        except urllib.error.HTTPError:
+            alive[endpoint] = True  # answered — the process is up
+        except Exception:  # noqa: BLE001 — reachability verdict
+            alive[endpoint] = False
+    return alive
+
+
 class WorkerState:
     """One scraped worker: reachability, identity, parsed payloads."""
 

@@ -208,10 +208,23 @@ class DisqOptions:
     # arms idle-worker stealing. Env equivalents: DISQ_TPU_SCHED,
     # DISQ_TPU_SCHED_LEASE_N/_LEASE_S/_STEAL (env wins for the tuning
     # knobs so subprocess workers inherit their launcher's settings).
+    # sched_run_weight is this run's share weight in the coordinator's
+    # weighted max-min lease quota (multi-run fairness — an interactive
+    # run outweighing a batch pass cannot be starved by it); env
+    # DISQ_TPU_SCHED_WEIGHT. sched_failover_dir arms coordinator
+    # failover: the coordinator journals every state transition to
+    # <dir>/journal.jsonl and advertises its address in
+    # <dir>/coordinator.addr, workers register member files there, and
+    # on coordinator death the lowest live process id replays the
+    # journal and resumes the pass; env DISQ_TPU_SCHED_FAILOVER. None
+    # (default) keeps PR 12's guarantee: no journal file, no standby,
+    # no extra state (check_overhead-guarded).
     scheduler: Optional[str] = None
     sched_lease_n: int = 2
     sched_lease_s: float = 10.0
     sched_steal: bool = True
+    sched_run_weight: float = 1.0
+    sched_failover_dir: Optional[str] = None
     # HTTP block-LRU capacity (fsw/http.py) — None keeps the built-in
     # default (32 blocks, or DISQ_TPU_HTTP_CACHE_BLOCKS); the locality
     # scorer reads occupancy off the fsw.http.cache.blocks gauge.
@@ -294,18 +307,27 @@ class DisqOptions:
 
     def with_scheduler(self, mode: str, lease_n: int = 2,
                        lease_s: float = 10.0,
-                       steal: bool = True) -> "DisqOptions":
+                       steal: bool = True,
+                       run_weight: float = 1.0,
+                       failover_dir: Optional[str] = None
+                       ) -> "DisqOptions":
         if not mode:
             raise ValueError(
-                "scheduler mode must be 'serve' or 'host:port'")
+                "scheduler mode must be 'serve', 'auto' or 'host:port'")
         if lease_n < 1:
             raise ValueError(f"sched_lease_n must be >= 1, got {lease_n}")
         if lease_s <= 0:
             raise ValueError(f"sched_lease_s must be > 0, got {lease_s}")
+        if run_weight <= 0:
+            raise ValueError(
+                f"sched_run_weight must be > 0, got {run_weight}")
         return replace(self, scheduler=str(mode),
                        sched_lease_n=int(lease_n),
                        sched_lease_s=float(lease_s),
-                       sched_steal=bool(steal))
+                       sched_steal=bool(steal),
+                       sched_run_weight=float(run_weight),
+                       sched_failover_dir=(str(failover_dir)
+                                           if failover_dir else None))
 
     def with_http_cache_blocks(self, n: int) -> "DisqOptions":
         if n < 1:
@@ -349,6 +371,22 @@ class CorruptBlockError(ValueError):
 class TransientIOError(IOError):
     """Marker for errors known to be transient (used by the fault
     injector and by wrappers that can prove transience)."""
+
+
+class CoordinatorLostError(TransientIOError):
+    """The shard-scheduler coordinator became unreachable mid-run
+    (``runtime/scheduler.py``).  Transient by inheritance: with
+    failover armed (``DISQ_TPU_SCHED_FAILOVER`` / a standby replaying
+    the ``SchedJournal``) the worker rediscovers the new coordinator
+    address and retries; without failover the worker's rediscovery
+    budget drains and this error surfaces as the read's failure."""
+
+    def __init__(self, message: str, *, address: str = "",
+                 op: str = "") -> None:
+        super().__init__(
+            f"{message} [address={address or '?'} op={op or '?'}]")
+        self.address = address
+        self.op = op
 
 
 class MissingReferenceError(ValueError):

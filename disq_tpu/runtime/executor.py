@@ -999,6 +999,8 @@ def run_write_stage(
     manifest=None,
     stage_name: str = "write.parts",
     retries: int = 1,
+    storage=None,
+    path: Optional[str] = None,
 ) -> List[Any]:
     """Run one write stage's shards through ``pipeline``, shard-level
     resumable. With a manifest, shards already recorded are skipped,
@@ -1008,8 +1010,23 @@ def run_write_stage(
     the stage worker, not emit order, so a crash mid-run preserves
     every staged shard even when a straggler holds up the ordered
     emit. Returns the per-shard info list in shard order, mixing
-    cached and fresh results."""
+    cached and fresh results.
+
+    With ``storage`` + ``path`` AND a manifest AND the shard scheduler
+    armed, the stage instead leases its shards through the coordinator
+    (``scheduler.scheduled_write_stage`` — the write direction of the
+    distributed data plane, with the manifest as the durable side);
+    otherwise this inline path runs unchanged, allocating nothing
+    extra."""
     from dataclasses import replace
+
+    if manifest is not None and storage is not None and path is not None:
+        from disq_tpu.runtime import scheduler
+
+        if scheduler.write_leasing_armed(storage):
+            return scheduler.scheduled_write_stage(
+                storage, path, pipeline, n_shards, make_task, manifest,
+                stage_name=stage_name, retries=retries)
 
     infos: List[Any] = [None] * n_shards
     pending: List[int] = []

@@ -12,12 +12,16 @@ is that postmortem half:
 - **Event ring** (:class:`FlightRecorder`): a bounded, lock-cheap ring
   of recent *events* — error classifications, retry escalations, hedge
   launches, deadline expiries, breaker transitions, watchdog stalls,
-  device-service flushes, quarantines — fed by one-line
-  ``record_event(kind, ...)`` hooks in ``errors.py``,
-  ``resilience.py``, ``executor.py``, ``device_service.py`` and
-  ``introspect.py``.  Spans sample *durations*; the event ring keeps
-  the *decisions* (why did shard 7 get hedged, when did the breaker
-  open) that explain an abort.
+  device-service flushes, quarantines, scheduler control-plane
+  transitions (membership joins/losses, lease expiries, steals, and
+  the failover ladder: ``sched_coordinator_lost`` →
+  ``sched_rediscovered`` / ``sched_takeover`` → ``sched_rejoin``) —
+  fed by one-line ``record_event(kind, ...)`` hooks in ``errors.py``,
+  ``resilience.py``, ``executor.py``, ``device_service.py``,
+  ``scheduler.py`` and ``introspect.py``.  Spans sample *durations*;
+  the event ring keeps the *decisions* (why did shard 7 get hedged,
+  when did the breaker open, who won the standby election) that
+  explain an abort.
 - **Postmortem bundles**: on any abort path (the pipelines'
   first-error-abort, a watchdog abort, a ``BreakerOpenError`` storm,
   or an explicit :func:`dump`) a bundle directory is written under

@@ -637,10 +637,13 @@ class _Handler(BaseHTTPRequestHandler):
             # anything here.
             from disq_tpu.runtime import scheduler
 
+            # every query param flows through (run=, dir=, host=…) so
+            # curl-side inspection matches the POST plane's vocabulary
             doc: Dict[str, Any] = {}
             for part in query.split("&"):
-                if part.startswith("run="):
-                    doc["run"] = urllib.parse.unquote(part[len("run="):])
+                name, eq, value = part.partition("=")
+                if eq and name:
+                    doc[name] = urllib.parse.unquote(value)
             code, body = scheduler.handle_http("GET", path, doc)
             self._send_json(body, code)
         elif path == "/debug/stacks":

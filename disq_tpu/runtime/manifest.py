@@ -20,6 +20,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 FORMAT_VERSION = 1
@@ -31,13 +32,26 @@ class StageManifest:
     Keyed by ``(stage, shard_id)``. The optional ``params`` fingerprint
     guards against resuming with different inputs: if the stored
     fingerprint differs from the current one, the manifest is reset.
+
+    Durability model: every flush writes the whole document atomically
+    (tmp + fsync + rename), so a crash at any point leaves a consistent
+    file. ``flush_interval_s`` batches flushes: 0 (the default) flushes
+    on every ``mark_done`` — the historical behavior; a positive
+    interval (armed by the write-leasing path, which completes shards
+    at RPC rate) defers the rewrite+fsync so at most one disk round
+    trip per interval happens, at the cost of a bounded durability
+    window — a SIGKILL can lose at most the last ``flush_interval_s``
+    of completion records, whose staged parts simply re-run
+    idempotently on resume.
     """
 
-    def __init__(self, path: str, params: Optional[Dict[str, Any]] = None):
+    def __init__(self, path: str, params: Optional[Dict[str, Any]] = None,
+                 flush_interval_s: float = 0.0):
         from disq_tpu.runtime import flightrec
         from disq_tpu.runtime.tracing import RUN_ID
 
         self.path = path
+        self.flush_interval_s = float(flush_interval_s)
         # Postmortem join: a bundle embeds this ledger's tail, so an
         # aborted run's "which shards were done" survives the process.
         flightrec.note_artifact("stage_manifest", path)
@@ -45,6 +59,13 @@ class StageManifest:
         # stage workers as each shard's part lands — mark_done (ledger
         # mutation + atomic flush) must not interleave across threads.
         self._lock = threading.RLock()
+        self._dirty = False
+        self._last_flush = 0.0
+        # Shared mode (write leasing): several processes mark shards
+        # into one manifest file; each flush then merges the on-disk
+        # document first so a whole-file rewrite cannot drop another
+        # host's completions.
+        self._shared = False
         self._state: Dict[str, Any] = {
             "version": FORMAT_VERSION,
             "params": params or {},
@@ -74,18 +95,83 @@ class StageManifest:
 
     # -- persistence -----------------------------------------------------
 
+    def _merge_stored_locked(self) -> None:
+        """Fold on-disk shard records this object doesn't have into
+        ``_state`` (another process appended them). Caller holds the
+        lock. Incompatible/damaged documents are ignored — the next
+        flush replaces them, exactly like the constructor's reset."""
+        try:
+            with open(self.path, "r") as f:
+                stored = json.load(f)
+        except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+            return
+        if (not isinstance(stored, dict)
+                or stored.get("version") != FORMAT_VERSION
+                or stored.get("params") != self._state["params"]):
+            return
+        for stage, st in (stored.get("stages") or {}).items():
+            mine = self._stage(stage)
+            for sid, info in (st.get("shards") or {}).items():
+                mine["shards"].setdefault(sid, info)
+            for sid, rid in (st.get("runs") or {}).items():
+                mine.setdefault("runs", {}).setdefault(sid, rid)
+
     def _flush(self) -> None:
         d = os.path.dirname(self.path) or "."
         os.makedirs(d, exist_ok=True)
+        if self._shared:
+            # Cross-process read-merge-rewrite must be atomic as a
+            # UNIT, not just the rename: two hosts interleaving their
+            # merges would lose the slower one's shards.
+            import fcntl
+
+            with open(self.path + ".lock", "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    self._merge_stored_locked()
+                    self._rewrite_locked(d)
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+        else:
+            self._rewrite_locked(d)
+        self._dirty = False
+        self._last_flush = time.monotonic()
+
+    def _rewrite_locked(self, d: str) -> None:
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".manifest-")
         try:
             with os.fdopen(fd, "w") as f:
                 json.dump(self._state, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.path)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+
+    def flush(self) -> None:
+        """Force any batched completion records to disk now."""
+        with self._lock:
+            if self._dirty:
+                self._flush()
+
+    def mark_shared(self, flush_interval_s: Optional[float] = None) -> None:
+        """Arm shared-manifest mode (several processes marking into one
+        file — the write-leasing durable side): flushes merge the
+        on-disk document first, and ``flush_interval_s`` (when given)
+        batches the rewrite+fsync behind that interval."""
+        with self._lock:
+            self._shared = True
+            if flush_interval_s is not None:
+                self.flush_interval_s = float(flush_interval_s)
+
+    def reload(self) -> None:
+        """Pick up shard records other processes flushed since we last
+        read the file (shared write leasing: the per-shard infos of
+        shards another host staged live only on disk)."""
+        with self._lock:
+            self._merge_stored_locked()
 
     # -- shard ledger ----------------------------------------------------
 
@@ -110,7 +196,11 @@ class StageManifest:
             # shard_info() keeps returning the caller's payload
             # verbatim; resumed manifests mix run ids here).
             st.setdefault("runs", {})[str(shard_id)] = RUN_ID
-            self._flush()
+            self._dirty = True
+            if (self.flush_interval_s <= 0.0
+                    or time.monotonic() - self._last_flush
+                    >= self.flush_interval_s):
+                self._flush()
 
     def shard_run_id(self, stage: str, shard_id: int) -> Optional[str]:
         """The ``run_id`` that marked this shard done (None for shards
@@ -378,3 +468,159 @@ class QuarantineManifest:
             self._entries[(path, block_offset)] = entry
             self._append(entry)
             return sidecar
+
+
+JOURNAL_FORMAT_VERSION = 1
+
+
+class SchedJournal:
+    """Durable append-only journal of scheduler state transitions —
+    the replication log behind coordinator failover
+    (``runtime/scheduler.py``).
+
+    Same JSONL shape as ``QuarantineManifest``: line 1 is
+    ``{"version": 1}``; every further line is one transition record
+    ``{"op", ...fields, "t"}`` where ``op`` is one of ``run`` / ``join``
+    / ``lease`` / ``done`` / ``steal`` / ``expire`` / ``takeover`` and
+    ``t`` is the coordinator's monotonic clock at the transition.  A
+    crash can tear at most the final line, which ``load()`` skips; a
+    standby that replays the surviving prefix therefore reconstructs a
+    state the dead coordinator actually passed through, and lease
+    expiry re-derives anything the torn tail would have changed.
+
+    Writes land in the OS file immediately (a standby tails a complete
+    record as soon as ``append`` returns) but ``fsync`` is batched —
+    every ``fsync_every`` records or whenever ``fsync_interval_s`` has
+    elapsed — so journaling done/lease at RPC rate doesn't serialize on
+    disk.  The durability bound: power loss (not mere process death)
+    can drop at most the unsynced suffix; everything a SIGKILL'd
+    *process* wrote survives regardless.
+    """
+
+    def __init__(self, path: str, fsync_every: int = 8,
+                 fsync_interval_s: float = 0.05) -> None:
+        from disq_tpu.runtime import flightrec
+
+        self.path = path
+        self.fsync_every = max(1, int(fsync_every))
+        self.fsync_interval_s = float(fsync_interval_s)
+        self._lock = threading.Lock()
+        self._f = None
+        self._header_ok = False
+        self._since_fsync = 0
+        self._last_fsync = 0.0
+        flightrec.note_artifact("sched_journal", path)
+
+    @staticmethod
+    def load(path: str) -> List[Dict[str, Any]]:
+        """All surviving transition records (header excluded), torn
+        tail tolerated — what ``replay_journal`` consumes.  A missing,
+        headerless or foreign-version journal loads as empty."""
+        try:
+            with open(path, "r") as f:
+                lines = f.read().splitlines()
+        except (OSError, UnicodeDecodeError):
+            return []
+        records: List[Dict[str, Any]] = []
+        for i, line in enumerate(lines):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if i == 0:
+                    break  # headerless/torn journal: don't trust it
+                continue  # torn tail line from a crash
+            if i == 0:
+                if (not isinstance(rec, dict)
+                        or rec.get("version") != JOURNAL_FORMAT_VERSION):
+                    break  # foreign journal: don't replay it
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+        return records
+
+    def _ends_with_newline(self) -> bool:
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                return f.read(1) == b"\n"
+        except OSError:
+            return True
+
+    def _open_locked(self):
+        if self._f is not None:
+            return self._f
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            # Trust an existing journal iff load() would: a takeover
+            # continues the dead coordinator's file so a SECOND
+            # failover still sees the full history. A headerless or
+            # foreign-version file is set aside, as QuarantineManifest
+            # does.
+            ok = False
+            try:
+                with open(self.path, "r") as f:
+                    first = f.readline()
+                head = json.loads(first)
+                ok = (isinstance(head, dict)
+                      and head.get("version") == JOURNAL_FORMAT_VERSION)
+            except (OSError, UnicodeDecodeError, json.JSONDecodeError):
+                ok = False
+            if not ok:
+                os.replace(self.path, self.path + ".bak")
+            elif not self._ends_with_newline():
+                # The dead coordinator tore the final line: terminate
+                # it so the FIRST record this process appends (the
+                # standby's ``takeover``) stays its own line instead
+                # of merging into the torn one and vanishing with it.
+                with open(self.path, "a") as f:
+                    f.write("\n")
+            self._header_ok = ok
+        self._f = open(self.path, "a")
+        if not self._header_ok:
+            self._f.write(json.dumps(
+                {"version": JOURNAL_FORMAT_VERSION}) + "\n")
+            self._f.flush()
+            self._header_ok = True
+        return self._f
+
+    def append(self, op: str, **fields: Any) -> None:
+        from disq_tpu.runtime.tracing import counter
+
+        rec = {"op": op}
+        rec.update(fields)
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            f = self._open_locked()
+            f.write(line)
+            f.flush()  # visible to a tailing standby immediately
+            self._since_fsync += 1
+            now = time.monotonic()
+            if (self._since_fsync >= self.fsync_every
+                    or now - self._last_fsync >= self.fsync_interval_s):
+                self._fsync_locked(now)
+        counter("sched.journal.records").inc(op=op)
+
+    def _fsync_locked(self, now: float) -> None:
+        from disq_tpu.runtime.tracing import counter
+
+        os.fsync(self._f.fileno())
+        self._since_fsync = 0
+        self._last_fsync = now
+        counter("sched.journal.fsyncs").inc()
+
+    def sync(self) -> None:
+        """Force the unsynced suffix to disk now (pass completion,
+        orderly shutdown)."""
+        with self._lock:
+            if self._f is not None and self._since_fsync:
+                self._fsync_locked(time.monotonic())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            if self._since_fsync:
+                self._fsync_locked(time.monotonic())
+            self._f.close()
+            self._f = None

@@ -63,6 +63,29 @@ from disq_tpu.runtime.errors import (
 from disq_tpu.runtime.tracing import counter, observe_gauge, record_span, span
 
 # ---------------------------------------------------------------------------
+# Coordinator rediscovery backoff (scheduler failover)
+# ---------------------------------------------------------------------------
+
+# How long a worker hunts for a new coordinator before its
+# CoordinatorLostError surfaces: 8 retries on a 0.25 s decorrelated-
+# jitter base gives a standby several seconds to probe liveness,
+# replay the journal and re-advertise, without a dead failover
+# directory wedging the read for minutes.
+REDISCOVERY_RETRIES = 8
+REDISCOVERY_BACKOFF_S = 0.25
+
+
+def rediscovery_retrier():
+    """The retrier behind ``SchedulerClient`` rediscovery — a plain
+    ``ShardRetrier`` so failover waits ride the same decorrelated
+    jitter, retry-budget accounting and telemetry as every other
+    transient-fault retry in the runtime."""
+    from disq_tpu.runtime.errors import ShardRetrier
+
+    return ShardRetrier(REDISCOVERY_RETRIES, REDISCOVERY_BACKOFF_S)
+
+
+# ---------------------------------------------------------------------------
 # Shared retry budget — the anti-stampede token bucket
 # ---------------------------------------------------------------------------
 

@@ -37,10 +37,47 @@ recovers it.  Three mechanisms ride on the queue:
   spilled shard serves it from the ledger instead of re-decoding, so
   handoff never re-does finished work.
 
+Control-plane hardening (armed by ``DISQ_TPU_SCHED_FAILOVER`` — a
+shared directory; off by default):
+
+- **Coordinator failover.**  The coordinator journals every state
+  transition (run registration, join, lease, done, steal, expiry) to a
+  durable ``SchedJournal`` (``runtime/manifest.py`` — append-only
+  JSONL, fsync'd batches) and advertises its address in
+  ``<dir>/coordinator.addr``.  When a worker's RPC fails past its
+  in-call retries, it rediscovers: re-read the address file, and if
+  the coordinator is truly gone, elect a standby — the *live* member
+  (``cluster.probe_liveness`` over ``<dir>/members/``) with the lowest
+  ``(process_id, pid, host)``.  The winner takes an ``O_EXCL``
+  takeover lock, replays the journal (``replay_journal`` — a pure
+  function ``scripts/check_resilience.py`` lints for exactness),
+  re-derives the lease table and epoch fencing, rebases lease clocks,
+  resumes serving at its own ``/sched/*`` address and re-advertises.
+  Losers spin on rediscovery (``CoordinatorLostError`` is transient;
+  the backoff rides ``ShardRetrier``) instead of raising.  Shards
+  finished before the crash are served from the shared ``ReadLedger``;
+  leases in flight at the crash expire and requeue exactly like a
+  worker death.
+- **Write-direction leasing.**  ``run_write_stage`` stage tasks lease
+  through the same coordinator (run key suffixed ``#write``; lease
+  docs carry ``dir=write``) with ``StageManifest`` as the durable
+  side: a SIGKILL'd writer's staged parts survive in the shared
+  manifest, its unfinished write shards requeue to survivors, and the
+  multi-host sorted write rides the same membership/steal machinery
+  as reads (``scheduled_write_stage``).
+- **Multi-run fairness.**  When several runs share one coordinator,
+  each lease grant is capped at the run's weighted max-min share of
+  in-flight leases (``DisqOptions.sched_run_weight``), so an
+  interactive run cannot be starved by a saturating batch pass:
+  every run can always hold at least one lease, and surplus capacity
+  still flows to whoever asks (``sched.quota.{granted,deferred}``).
+
 Zero overhead when disabled (the default): ``client_for_storage``
 returns ``None``, ``scheduled_map_ordered`` falls straight through to
 ``map_ordered_resumable``, and no coordinator object, thread or socket
-exists (``scripts/check_overhead.py`` guards this structurally).
+exists (``scripts/check_overhead.py`` guards this structurally —
+including that failover-off means no journal file and no standby
+thread).
 
 Knobs (``DisqOptions`` fields / env — env wins for the ``sched_*``
 tuning knobs so subprocess workers are configured by their launcher):
@@ -62,12 +99,21 @@ tuning knobs so subprocess workers are configured by their launcher):
   comparisons pay identical RPC overhead.
 - ``DISQ_TPU_SCHED_SALT``: appended to the run key so repeated reads
   of the same input register as distinct runs (bench reps).
+- ``sched_run_weight`` / ``DISQ_TPU_SCHED_WEIGHT``: this run's fairness
+  weight (default 1.0) — its max-min share of in-flight leases when
+  runs contend.
+- ``sched_failover_dir`` / ``DISQ_TPU_SCHED_FAILOVER``: shared
+  directory arming coordinator failover (journal + address file +
+  member registry).  ``scheduler="auto"`` discovers the coordinator
+  address from this directory instead of naming it.
 """
 
 from __future__ import annotations
 
 import bisect
+import http.client
 import json
+import math
 import os
 import threading
 import time
@@ -101,10 +147,13 @@ class _Run:
     """One registered read's queue state on the coordinator."""
 
     def __init__(self, key: str, path: str,
-                 ranges: Dict[int, Optional[Tuple[int, int]]]) -> None:
+                 ranges: Dict[int, Optional[Tuple[int, int]]],
+                 weight: float = 1.0, direction: str = "read") -> None:
         self.key = key
         self.path = path
         self.ranges = ranges
+        self.weight = max(1e-9, float(weight))  # fairness share weight
+        self.direction = direction  # "read" | "write" lease direction
         self.joined: set = set()  # hosts that joined THIS pass
         self.epoch = 1            # pass number for this run key
         self.pending: List[int] = sorted(ranges)   # ascending shard ids
@@ -131,7 +180,7 @@ class ShardCoordinator:
 
     def __init__(self, lease_s: float = DEFAULT_LEASE_S,
                  steal_after_s: Optional[float] = None,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic, journal=None) -> None:
         self.lease_s = float(lease_s)
         self.steal_after_s = (float(steal_after_s) if steal_after_s
                               is not None else self.lease_s / 3.0)
@@ -140,6 +189,19 @@ class ShardCoordinator:
         self._runs: Dict[str, _Run] = {}
         self._hosts: Dict[str, float] = {}  # host -> last_seen
         self._epochs: Dict[str, int] = {}   # run key -> last pass number
+        # Failover replication log (manifest.SchedJournal) — None is
+        # the zero-overhead default: no journal object, no file.
+        self._journal = journal
+
+    def attach_journal(self, journal) -> None:
+        self._journal = journal
+
+    def _journal_locked(self, op: str, **fields: Any) -> None:
+        """Record one state transition.  Called under the coordinator
+        lock so the journal's record order IS the mutation order —
+        what makes ``replay_journal`` exact."""
+        if self._journal is not None:
+            self._journal.append(op, **fields)
 
     # -- sweeps -------------------------------------------------------------
 
@@ -154,6 +216,8 @@ class ShardCoordinator:
                     bisect.insort(run.pending, shard)
                     run.requeued.append(shard)
                     expired.append((run.key, host, shard))
+                    self._journal_locked("expire", key=run.key,
+                                         host=host, shard=shard, t=now)
         for host, seen in list(self._hosts.items()):
             if now - seen < 2.0 * self.lease_s:
                 continue
@@ -161,6 +225,7 @@ class ShardCoordinator:
                    for h, _s in run.leases.values()):
                 continue
             del self._hosts[host]
+            self._journal_locked("member_lost", host=host, t=now)
             flightrec.record_event("sched_member_lost", host=host)
         if expired:
             observe_gauge("sched.members", len(self._hosts))
@@ -171,11 +236,17 @@ class ShardCoordinator:
 
     # -- requests -----------------------------------------------------------
 
-    def join(self, host: str, run: Optional[Dict[str, Any]] = None
-             ) -> Dict[str, Any]:
+    def join(self, host: str, run: Optional[Dict[str, Any]] = None,
+             rejoin: bool = False) -> Dict[str, Any]:
         """Register ``host`` (idempotent) and, when ``run`` carries a
         shard table, register the run (first registration wins; all
-        workers compute the identical table from the same input)."""
+        workers compute the identical table from the same input).
+
+        ``rejoin`` marks a failover re-registration: the worker is
+        recovering its membership after a coordinator handoff, not
+        starting a new read — a rejoin must NEVER restart a finished
+        pass (a standby that replayed a completed journal would
+        otherwise re-decode every shard)."""
         now = self._clock()
         with self._lock:
             fresh = host not in self._hosts
@@ -185,7 +256,7 @@ class ShardCoordinator:
                 key = str(run["key"])
                 existing = self._runs.get(key)
                 if (existing is not None and existing.finished
-                        and host in existing.joined):
+                        and host in existing.joined and not rejoin):
                     # A host that participated in the (now finished)
                     # pass is registering the same input again: that is
                     # a NEW read, not a late same-pass joiner — start a
@@ -200,15 +271,27 @@ class ShardCoordinator:
                         for sid, rng in (run.get("shards") or {}).items()
                     }
                     fresh_run = _Run(key, str(run.get("path", "")),
-                                     ranges)
+                                     ranges,
+                                     weight=float(run.get("weight")
+                                                  or 1.0),
+                                     direction=str(run.get("dir")
+                                                   or "read"))
                     fresh_run.epoch = self._epochs.get(key, 0) + 1
                     self._epochs[key] = fresh_run.epoch
                     self._runs[key] = fresh_run
                     registered = True
+                    self._journal_locked(
+                        "run", key=key, path=fresh_run.path,
+                        shards={str(s): (list(r) if r else None)
+                                for s, r in ranges.items()},
+                        epoch=fresh_run.epoch, weight=fresh_run.weight,
+                        dir=fresh_run.direction, host=host, t=now)
                 self._runs[key].joined.add(host)
                 epoch = self._runs[key].epoch
             else:
+                key = None
                 epoch = None
+            self._journal_locked("join", host=host, key=key, t=now)
             members = len(self._hosts)
         observe_gauge("sched.members", members)
         if fresh:
@@ -232,16 +315,40 @@ class ShardCoordinator:
             return sum(1 for b in blocks if first <= b <= last)
         return sum(1 for b in range(first, last + 1) if b in blocks)
 
+    def _quota_locked(self, run: _Run, want: int) -> Tuple[int, int]:
+        """Weighted max-min fairness cap: when another unfinished run
+        has pending work, ``run`` may only grow its in-flight leases to
+        its weighted share of the total (its weight over the sum of
+        contending runs' weights), never below one — every run always
+        progresses; a run alone on the coordinator is never throttled.
+        Returns ``(granted_cap, deferred)``; deferred == 0 means the
+        quota didn't engage or didn't bind."""
+        contending = [r for r in self._runs.values()
+                      if not r.finished and (r.pending or r.leases)]
+        others_waiting = any(r is not run and r.pending
+                             for r in contending)
+        if not others_waiting:
+            return want, 0
+        total_weight = sum(r.weight for r in contending) or run.weight
+        in_flight = sum(len(r.leases) for r in contending)
+        share = max(1, math.ceil(
+            (in_flight + want) * run.weight / total_weight))
+        cap = max(0, share - len(run.leases))
+        return min(want, cap), max(0, want - cap)
+
     def lease(self, host: str, key: str, want: int = DEFAULT_LEASE_N,
               block_size: Optional[int] = None,
               blocks: Optional[Sequence[int]] = None,
               static_of: Optional[Tuple[int, int]] = None,
-              epoch: Optional[int] = None) -> Dict[str, Any]:
+              epoch: Optional[int] = None,
+              direction: Optional[str] = None) -> Dict[str, Any]:
         """Hand ``host`` up to ``want`` pending shards: locality-scored
         picks first (shards whose byte range overlaps the host's cached
         blocks), then FIFO ascending.  ``static_of=(k, N)`` restricts
         eligibility to ``shard % N == k`` — the static-split compare
-        mode."""
+        mode.  ``direction`` (``dir=`` on the wire) must match the
+        run's registered lease direction when given — a read loop
+        leasing a write run's key is a caller bug worth failing."""
         now = self._clock()
         want = max(1, int(want))
         cached = frozenset(int(b) for b in blocks) if blocks else frozenset()
@@ -251,13 +358,18 @@ class ShardCoordinator:
             run = self._runs.get(key)
             if run is None:
                 return {"error": f"unknown run {key!r}", "shards": []}
+            if direction is not None and direction != run.direction:
+                return {"error": f"run {key!r} leases dir="
+                                 f"{run.direction}, not dir={direction}",
+                        "shards": []}
             if epoch is not None and epoch != run.epoch:
                 # the caller belongs to a previous pass of this key —
                 # its pass is over; it must not drain the new pass
                 return {"shards": [], "finished": True, "stale": True}
+            want, deferred = self._quota_locked(run, want)
             eligible = [s for s in run.pending
                         if static_of is None
-                        or s % static_of[1] == static_of[0]]
+                        or s % static_of[1] == static_of[0]] if want else []
             picked: List[int] = []
             hits = 0
             if cached and block_size:
@@ -279,6 +391,9 @@ class ShardCoordinator:
             for s in picked:
                 run.pending.remove(s)
                 run.leases[s] = (host, now)
+            if picked:
+                self._journal_locked("lease", key=key, host=host,
+                                     shards=list(picked), t=now)
             run.locality_hits += hits
             run.locality_misses += len(picked) - hits
             pending_n = len(run.pending)
@@ -291,6 +406,11 @@ class ShardCoordinator:
             if len(picked) - hits:
                 counter("sched.locality").inc(len(picked) - hits,
                                               result="miss")
+        if deferred:
+            # the fairness quota engaged and bound this grant
+            counter("sched.quota.deferred").inc(deferred)
+            if picked:
+                counter("sched.quota.granted").inc(len(picked))
         observe_gauge("sched.queue_depth", pending_n)
         return {"shards": sorted(picked), "pending": pending_n,
                 "outstanding": outstanding, "finished": finished}
@@ -320,6 +440,8 @@ class ShardCoordinator:
                 # the queue still wins — retract the duplicate work
                 if shard in run.pending:
                     run.pending.remove(shard)
+                self._journal_locked("done", key=key, host=host,
+                                     shard=shard, t=now)
             # Idempotent for the WINNER: the client retries a done POST
             # whose response was lost — telling the true winner
             # won=False would make it drop the only copy of the shard's
@@ -361,6 +483,8 @@ class ShardCoordinator:
             _since, shard = min(stale[victim])
             run.leases[shard] = (host, now)
             run.stolen.append(shard)
+            self._journal_locked("steal", key=key, host=host,
+                                 victim=victim, shard=shard, t=now)
             finished = run.finished
         counter("sched.steals").inc(victim=victim)
         flightrec.record_event("sched_steal", thief=host, victim=victim,
@@ -380,6 +504,8 @@ class ShardCoordinator:
                 runs[k] = {
                     "path": run.path,
                     "epoch": run.epoch,
+                    "dir": run.direction,
+                    "weight": run.weight,
                     "shards": len(run.ranges),
                     "pending": list(run.pending),
                     "leases": {str(s): {"host": h, "age_s": round(
@@ -397,6 +523,129 @@ class ShardCoordinator:
                 }
             return {"members": sorted(self._hosts), "runs": runs}
 
+    # -- failover -----------------------------------------------------------
+
+    def state_fingerprint(self) -> Dict[str, Any]:
+        """The canonical queue state — epoch fencing plus every run's
+        full lease table (pending / leases-with-timestamps / done /
+        requeued / stolen).  ``replay_journal`` over a coordinator's
+        journal must reproduce this EXACTLY (``check_resilience.py``
+        lints the invariant); telemetry-only fields (locality counts,
+        host heartbeats) are deliberately excluded."""
+        with self._lock:
+            runs: Dict[str, Any] = {}
+            for k, run in self._runs.items():
+                runs[k] = {
+                    "path": run.path,
+                    "dir": run.direction,
+                    "weight": run.weight,
+                    "epoch": run.epoch,
+                    "joined": sorted(run.joined),
+                    "ranges": {str(s): (list(r) if r else None)
+                               for s, r in sorted(run.ranges.items())},
+                    "pending": list(run.pending),
+                    "leases": {str(s): [h, t] for s, (h, t)
+                               in sorted(run.leases.items())},
+                    "done": {str(s): h for s, h
+                             in sorted(run.done.items())},
+                    "requeued": list(run.requeued),
+                    "stolen": list(run.stolen),
+                }
+            return {"epochs": dict(self._epochs), "runs": runs}
+
+    def rebase_clock(self, clock=time.monotonic) -> None:
+        """Shift replayed lease/heartbeat timestamps into THIS
+        process's monotonic timebase (the journal's ``t`` values come
+        from the dead coordinator's clock, which shares no origin with
+        ours).  The newest replayed timestamp maps to "now", so
+        relative lease ages are preserved: leases the dead coordinator
+        believed fresh get a full ``lease_s`` to complete or expire
+        back into the queue — the same fencing a worker death gets."""
+        with self._lock:
+            last = 0.0
+            for run in self._runs.values():
+                for _h, since in run.leases.values():
+                    last = max(last, since)
+            for seen in self._hosts.values():
+                last = max(last, seen)
+            delta = clock() - last
+            for run in self._runs.values():
+                run.leases = {s: (h, since + delta)
+                              for s, (h, since) in run.leases.items()}
+            self._hosts = {h: seen + delta
+                           for h, seen in self._hosts.items()}
+            self._clock = clock
+
+
+def replay_journal(records: Sequence[Dict[str, Any]],
+                   lease_s: float = DEFAULT_LEASE_S,
+                   steal_after_s: Optional[float] = None
+                   ) -> ShardCoordinator:
+    """Rebuild a coordinator from its ``SchedJournal`` records — the
+    standby's takeover path, and a PURE function of the record list:
+    no clock reads, no I/O, no journaling.  Records are applied in
+    order exactly as the dead coordinator's locked mutations ran, so
+    ``replayed.state_fingerprint() == dead.state_fingerprint()``
+    (``scripts/check_resilience.py`` lints this).  The caller rebases
+    the clock (``rebase_clock``) before serving."""
+    last_t = 0.0
+    coord = ShardCoordinator(lease_s, steal_after_s,
+                             clock=lambda: last_t)
+    runs = coord._runs
+    for rec in records:
+        op = rec.get("op")
+        t = float(rec.get("t") or 0.0)
+        last_t = max(last_t, t)
+        key = rec.get("key")
+        run = runs.get(key) if key is not None else None
+        if op == "run":
+            ranges = {int(s): (tuple(r) if r else None)
+                      for s, r in (rec.get("shards") or {}).items()}
+            fresh = _Run(str(key), str(rec.get("path", "")), ranges,
+                         weight=float(rec.get("weight") or 1.0),
+                         direction=str(rec.get("dir") or "read"))
+            fresh.epoch = int(rec.get("epoch") or 1)
+            coord._epochs[str(key)] = fresh.epoch
+            runs[str(key)] = fresh
+            fresh.joined.add(str(rec.get("host", "")))
+        elif op == "join":
+            coord._hosts[str(rec.get("host", ""))] = t
+            if run is not None:
+                run.joined.add(str(rec.get("host", "")))
+        elif op == "lease" and run is not None:
+            host = str(rec.get("host", ""))
+            coord._hosts[host] = t
+            for s in rec.get("shards") or []:
+                s = int(s)
+                if s in run.pending:
+                    run.pending.remove(s)
+                run.leases[s] = (host, t)
+        elif op == "done" and run is not None:
+            host = str(rec.get("host", ""))
+            coord._hosts[host] = t
+            shard = int(rec["shard"])
+            if shard not in run.done:
+                run.done[shard] = host
+                run.leases.pop(shard, None)
+                if shard in run.pending:
+                    run.pending.remove(shard)
+        elif op == "steal" and run is not None:
+            host = str(rec.get("host", ""))
+            coord._hosts[host] = t
+            shard = int(rec["shard"])
+            run.leases[shard] = (host, t)
+            run.stolen.append(shard)
+        elif op == "expire" and run is not None:
+            shard = int(rec["shard"])
+            if run.leases.pop(shard, None) is not None:
+                bisect.insort(run.pending, shard)
+            run.requeued.append(shard)
+        elif op == "member_lost":
+            coord._hosts.pop(str(rec.get("host", "")), None)
+        # "takeover" and unknown future ops: membership/provenance
+        # markers, no queue effect
+    return coord
+
 
 # ---------------------------------------------------------------------------
 # Module coordinator lifecycle + HTTP dispatch (runtime/introspect.py
@@ -405,32 +654,216 @@ class ShardCoordinator:
 
 _COORD_LOCK = threading.Lock()
 _COORDINATOR: Optional[ShardCoordinator] = None
+_JOURNAL = None  # manifest.SchedJournal when failover is armed
 
 
 def active_coordinator() -> Optional[ShardCoordinator]:
     return _COORDINATOR
 
 
+def active_journal():
+    """The coordinator's failover journal, or None (the default —
+    ``check_overhead.py`` asserts failover-off keeps this None and
+    writes no journal file)."""
+    return _JOURNAL
+
+
+# -- failover directory layout ----------------------------------------------
+#
+# <failover_dir>/
+#   journal.jsonl      SchedJournal — the coordinator's replication log
+#   coordinator.addr   JSON {address, host, pid, process_id} (atomic)
+#   members/<host>.json  one per worker: {host, process_id, pid, endpoint}
+#   takeover.lock      O_EXCL election guard (owner pid inside)
+
+
+def _failover_paths(failover_dir: str) -> Dict[str, str]:
+    return {
+        "journal": os.path.join(failover_dir, "journal.jsonl"),
+        "addr": os.path.join(failover_dir, "coordinator.addr"),
+        "members": os.path.join(failover_dir, "members"),
+        "lock": os.path.join(failover_dir, "takeover.lock"),
+    }
+
+
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    import tempfile
+
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".addr-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def advertise_coordinator(failover_dir: str, address: str) -> None:
+    """Publish the live coordinator's address (atomic rename — a
+    reader sees the old or the new document, never a torn one)."""
+    from disq_tpu.runtime.multihost import process_id
+
+    _atomic_write_json(_failover_paths(failover_dir)["addr"], {
+        "address": address,
+        "pid": os.getpid(),
+        "process_id": process_id(),
+    })
+
+
+def discover_coordinator(failover_dir: str,
+                         wait_s: float = 10.0) -> str:
+    """Resolve the coordinator address from the failover directory
+    (``scheduler="auto"``), waiting up to ``wait_s`` for the
+    coordinator to advertise on a cold start."""
+    addr_path = _failover_paths(failover_dir)["addr"]
+    deadline = time.monotonic() + wait_s
+    while True:
+        doc = _read_json(addr_path)
+        if doc and doc.get("address"):
+            return str(doc["address"])
+        if time.monotonic() >= deadline:
+            raise IOError(
+                f"no scheduler coordinator advertised in "
+                f"{failover_dir!r} after {wait_s:.1f}s")
+        time.sleep(0.05)
+
+
+def register_member(failover_dir: str, host: str, endpoint: str) -> None:
+    """Enroll this process in the standby electorate: its liveness
+    endpoint and election key (process_id, pid)."""
+    from disq_tpu.runtime.multihost import process_id
+
+    members = _failover_paths(failover_dir)["members"]
+    _atomic_write_json(os.path.join(members, f"{host}.json"), {
+        "host": host,
+        "process_id": process_id(),
+        "pid": os.getpid(),
+        "endpoint": endpoint,
+    })
+
+
+def _list_members(failover_dir: str) -> List[Dict[str, Any]]:
+    members_dir = _failover_paths(failover_dir)["members"]
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(members_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        doc = _read_json(os.path.join(members_dir, name))
+        if doc and doc.get("endpoint"):
+            out.append(doc)
+    return out
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError):
+        return False
+    return True
+
+
+def _acquire_takeover_lock(failover_dir: str, host: str) -> bool:
+    """One standby wins the right to replay: ``O_EXCL`` create; a lock
+    whose recorded owner pid is dead is stale and reclaimed (the
+    winning standby crashed mid-takeover)."""
+    lock_path = _failover_paths(failover_dir)["lock"]
+    payload = json.dumps({"host": host, "pid": os.getpid()})
+    for _attempt in (0, 1):
+        try:
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            return True
+        except FileExistsError:
+            owner = _read_json(lock_path)
+            if owner is not None and _pid_alive(owner.get("pid", -1)):
+                return False
+            try:  # stale lock: owner died mid-takeover — reclaim
+                os.unlink(lock_path)
+            except OSError:
+                return False
+    return False
+
+
+def _release_takeover_lock(failover_dir: str) -> None:
+    try:
+        os.unlink(_failover_paths(failover_dir)["lock"])
+    except OSError:
+        pass
+
+
 def serve_coordinator(lease_s: float = DEFAULT_LEASE_S,
                       steal_after_s: Optional[float] = None,
-                      port: int = 0) -> str:
+                      port: int = 0,
+                      failover_dir: Optional[str] = None) -> str:
     """Host the coordinator in this process on the introspection
-    endpoint (started if needed); idempotent.  Returns ``host:port``."""
-    global _COORDINATOR
+    endpoint (started if needed); idempotent.  Returns ``host:port``.
+    With ``failover_dir`` the coordinator journals every transition
+    there and advertises its address for standby rediscovery."""
+    global _COORDINATOR, _JOURNAL
     from disq_tpu.runtime.introspect import start_introspect_server
 
     with _COORD_LOCK:
         if _COORDINATOR is None:
-            _COORDINATOR = ShardCoordinator(lease_s, steal_after_s)
+            journal = None
+            if failover_dir:
+                from disq_tpu.runtime.manifest import SchedJournal
+
+                journal = SchedJournal(
+                    _failover_paths(failover_dir)["journal"])
+            _COORDINATOR = ShardCoordinator(lease_s, steal_after_s,
+                                            journal=journal)
+            _JOURNAL = journal
+    address = start_introspect_server(port)
+    if failover_dir and _JOURNAL is not None:
+        advertise_coordinator(failover_dir, address)
+    return address
+
+
+def adopt_coordinator(coord: ShardCoordinator, journal=None,
+                      port: int = 0) -> str:
+    """Install a REPLAYED coordinator in this process (the standby's
+    takeover) and serve it on this process's introspection endpoint.
+    Returns the address to advertise."""
+    global _COORDINATOR, _JOURNAL
+    from disq_tpu.runtime.introspect import start_introspect_server
+
+    with _COORD_LOCK:
+        if journal is not None:
+            coord.attach_journal(journal)
+        _COORDINATOR = coord
+        _JOURNAL = journal
     return start_introspect_server(port)
 
 
 def stop_coordinator() -> None:
     """Test hook: forget the coordinator (the introspection server, if
     any, keeps running — ``reset_introspection`` owns that)."""
-    global _COORDINATOR
+    global _COORDINATOR, _JOURNAL
     with _COORD_LOCK:
         _COORDINATOR = None
+        journal, _JOURNAL = _JOURNAL, None
+    if journal is not None:
+        journal.close()
 
 
 def handle_http(method: str, path: str,
@@ -449,12 +882,14 @@ def handle_http(method: str, path: str,
         if not host:
             return 400, {"error": "missing host"}
         if op == "join":
-            return 200, coord.join(host, doc.get("run"))
+            return 200, coord.join(host, doc.get("run"),
+                                   rejoin=bool(doc.get("rejoin")))
         epoch = doc.get("epoch")
         if epoch is not None:
             epoch = int(epoch)
         if op == "lease":
             static_of = doc.get("static_of")
+            direction = doc.get("dir")
             return 200, coord.lease(
                 host, str(doc.get("run", "")),
                 want=int(doc.get("want", DEFAULT_LEASE_N)),
@@ -462,7 +897,8 @@ def handle_http(method: str, path: str,
                 blocks=doc.get("blocks"),
                 static_of=(tuple(int(x) for x in static_of)
                            if static_of else None),
-                epoch=epoch)
+                epoch=epoch,
+                direction=(str(direction) if direction else None))
         if op == "done":
             return 200, coord.done(host, str(doc.get("run", "")),
                                    int(doc["shard"]), epoch=epoch)
@@ -483,12 +919,24 @@ def handle_http(method: str, path: str,
 
 
 class SchedulerClient:
-    """Worker-side JSON-over-HTTP client for the coordinator plane."""
+    """Worker-side JSON-over-HTTP client for the coordinator plane.
+
+    With ``failover_dir`` set, an RPC that exhausts its in-call retries
+    does NOT raise: the client rediscovers the coordinator — re-read
+    the advertised address, or (when this process is the lowest live
+    member) take over by replaying the journal — and retries the call
+    on a ``ShardRetrier`` backoff (``resilience.rediscovery_retrier``),
+    raising the transient ``CoordinatorLostError`` only when the whole
+    rediscovery budget drains."""
 
     def __init__(self, address: str, host: str,
                  lease_n: int = DEFAULT_LEASE_N, steal: bool = True,
                  static_of: Optional[Tuple[int, int]] = None,
-                 serves: bool = False, timeout_s: float = 10.0) -> None:
+                 serves: bool = False, timeout_s: float = 10.0,
+                 weight: float = 1.0,
+                 failover_dir: Optional[str] = None,
+                 lease_s: float = DEFAULT_LEASE_S,
+                 direction: str = "read") -> None:
         self.address = address
         self.host = host
         self.lease_n = max(1, int(lease_n))
@@ -496,10 +944,15 @@ class SchedulerClient:
         self.static_of = static_of
         self.serves = serves  # this process hosts the coordinator
         self.timeout_s = timeout_s
+        self.weight = float(weight)
+        self.failover_dir = failover_dir
+        self.lease_s = float(lease_s)  # replay parameter on takeover
+        self.direction = direction
         self.run_key: Optional[str] = None
         self.epoch: Optional[int] = None  # pass number, set by join()
+        self._run_doc: Optional[Dict[str, Any]] = None  # for rejoin
 
-    def _call(self, op: str, doc: Dict[str, Any]) -> Dict[str, Any]:
+    def _call_once(self, op: str, doc: Dict[str, Any]) -> Dict[str, Any]:
         url = f"http://{self.address}/sched/{op}"
         body = json.dumps(doc).encode()
         last: Optional[Exception] = None
@@ -514,20 +967,180 @@ class SchedulerClient:
                         return json.loads(resp.read())
                 except urllib.error.HTTPError as e:
                     # coordinator answered: surface its error verbatim
+                    # (a death mid-error-body still lands in failover)
                     try:
                         return json.loads(e.read())
-                    except ValueError:
+                    except (ValueError, OSError,
+                            http.client.HTTPException):
                         raise IOError(
                             f"scheduler {op} failed: HTTP {e.code}") from e
-                except (urllib.error.URLError, OSError, ValueError) as e:
+                except (urllib.error.URLError, OSError, ValueError,
+                        http.client.HTTPException) as e:
+                    # HTTPException covers IncompleteRead: a coordinator
+                    # SIGKILLed mid-response-body raises it from
+                    # resp.read(), and it is NOT an OSError — it must
+                    # still land in the retry/failover ladder, not kill
+                    # the worker.
                     last = e
                     time.sleep(_RPC_BACKOFF_S * (attempt + 1))
         raise IOError(
             f"scheduler coordinator at {self.address} unreachable "
             f"({op}): {last}") from last
 
+    def _call(self, op: str, doc: Dict[str, Any]) -> Dict[str, Any]:
+        try:
+            return self._call_once(op, doc)
+        except IOError:
+            if not self.failover_dir:
+                raise  # failover off: PR 12's fail-loudly contract
+            return self._call_failover(op, doc)
+
+    # -- failover: rediscovery + standby election ---------------------------
+
+    def _call_failover(self, op: str,
+                       doc: Dict[str, Any]) -> Dict[str, Any]:
+        from disq_tpu.runtime.errors import CoordinatorLostError
+        from disq_tpu.runtime.resilience import rediscovery_retrier
+
+        flightrec.record_event("sched_coordinator_lost",
+                               address=self.address, op=op)
+
+        def attempt() -> Dict[str, Any]:
+            self._rediscover()
+            # a rejoin during rediscovery may have moved the epoch
+            fresh = dict(doc)
+            if "epoch" in fresh:
+                fresh["epoch"] = self.epoch
+            try:
+                return self._call_once(op, fresh)
+            except IOError as e:
+                raise CoordinatorLostError(
+                    "scheduler coordinator lost",
+                    address=self.address, op=op) from e
+
+        return rediscovery_retrier().call(attempt, what="sched")
+
+    def _rediscover(self) -> None:
+        """One rediscovery step: prefer the advertised address (some
+        standby already took over), else run the election and take
+        over ourselves if we are the lowest live member."""
+        paths = _failover_paths(self.failover_dir)
+        info = _read_json(paths["addr"])
+        advertised = str(info.get("address", "")) if info else ""
+        if advertised and advertised != self.address:
+            self.address = advertised
+            counter("sched.failover.rediscoveries").inc()
+            flightrec.record_event("sched_rediscovered",
+                                   address=advertised, host=self.host)
+            self._rejoin()
+            return
+        if self.serves and active_coordinator() is not None:
+            return  # we ARE the (possibly just-adopted) coordinator
+        self._maybe_takeover()
+
+    def _election_key(self, member: Dict[str, Any]) -> Tuple:
+        return (int(member.get("process_id") or 0),
+                int(member.get("pid") or 0),
+                str(member.get("host") or ""))
+
+    def _maybe_takeover(self) -> None:
+        from disq_tpu.runtime.cluster import probe_liveness
+
+        members = _list_members(self.failover_dir)
+        if not members:
+            return
+        alive = probe_liveness([m["endpoint"] for m in members],
+                               timeout_s=1.0)
+        live = sorted((m for m in members if alive.get(m["endpoint"])),
+                      key=self._election_key)
+        if not live:
+            return
+        winner = live[0]
+        if (str(winner.get("host")) != self.host
+                or int(winner.get("pid") or -1) != os.getpid()):
+            return  # a lower-ranked live member owns the takeover
+        if not _acquire_takeover_lock(self.failover_dir, self.host):
+            return
+        try:
+            # Re-check under the lock: another standby may have won a
+            # previous election and already be serving.
+            info = _read_json(_failover_paths(self.failover_dir)["addr"])
+            if (info and str(info.get("address", "")) != self.address
+                    and _pid_alive(info.get("pid", -1))):
+                self.address = str(info["address"])
+                self._rejoin()
+                return
+            self._take_over_locked()
+        finally:
+            _release_takeover_lock(self.failover_dir)
+
+    def _take_over_locked(self) -> None:
+        """Replay the journal and become the coordinator (the standby
+        promotion path; the takeover lock is held)."""
+        from disq_tpu.runtime.manifest import SchedJournal
+
+        paths = _failover_paths(self.failover_dir)
+        records = SchedJournal.load(paths["journal"])
+        coord = replay_journal(records, lease_s=self.lease_s)
+        coord.rebase_clock()
+        journal = SchedJournal(paths["journal"])
+        address = adopt_coordinator(coord, journal)
+        journal.append("takeover", host=self.host, pid=os.getpid())
+        advertise_coordinator(self.failover_dir, address)
+        self.address = address
+        self.serves = True
+        counter("sched.failover.takeovers").inc(host=self.host)
+        flightrec.record_event("sched_takeover", host=self.host,
+                               address=address,
+                               replayed=len(records))
+        self._rejoin()
+
+    def _rejoin(self) -> None:
+        """Re-register with the (new) coordinator using the join doc
+        cached at join() — replay preserved the run, so this is a
+        heartbeat that refreshes our epoch."""
+        if self._run_doc is None:
+            return
+        try:
+            resp = self._call_once("join", {"host": self.host,
+                                            "run": self._run_doc,
+                                            "rejoin": True})
+        except IOError:
+            return  # the next retrier attempt rediscovers again
+        if resp.get("epoch") is not None:
+            self.epoch = resp.get("epoch")
+
+    def _with_rejoin(self, op: str,
+                     doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Absorb an ``unknown run`` error by rejoining and retrying
+        once: a coordinator restarted between our join and this call
+        (the failover window) must not abort an otherwise-healthy
+        worker."""
+        resp = self._call(op, doc)
+        err = resp.get("error")
+        if (isinstance(err, str) and "unknown run" in err
+                and self._run_doc is not None):
+            jr = self._call("join", {"host": self.host,
+                                     "run": self._run_doc,
+                                     "rejoin": True})
+            if jr.get("epoch") is not None:
+                self.epoch = jr.get("epoch")
+            doc = dict(doc)
+            if "epoch" in doc:
+                doc["epoch"] = self.epoch
+            flightrec.record_event("sched_rejoin", host=self.host,
+                                   op=op, run=self.run_key)
+            resp = self._call(op, doc)
+        return resp
+
+    # -- the four RPCs ------------------------------------------------------
+
     def join(self, run_doc: Dict[str, Any]) -> Dict[str, Any]:
         self.run_key = str(run_doc["key"])
+        run_doc.setdefault("weight", self.weight)
+        if self.direction != "read":
+            run_doc.setdefault("dir", self.direction)
+        self._run_doc = run_doc
         resp = self._call("join", {"host": self.host, "run": run_doc})
         self.epoch = resp.get("epoch")
         return resp
@@ -540,17 +1153,20 @@ class SchedulerClient:
             doc.update(cache)
         if self.static_of is not None:
             doc["static_of"] = list(self.static_of)
-        return self._call("lease", doc)
+        if self.direction != "read":
+            doc["dir"] = self.direction
+        return self._with_rejoin("lease", doc)
 
     def steal_once(self) -> Dict[str, Any]:
-        return self._call("steal", {"host": self.host,
-                                    "run": self.run_key,
-                                    "epoch": self.epoch})
+        return self._with_rejoin("steal", {"host": self.host,
+                                           "run": self.run_key,
+                                           "epoch": self.epoch})
 
     def done(self, shard: int) -> Dict[str, Any]:
-        return self._call("done", {"host": self.host, "run": self.run_key,
-                                   "shard": int(shard),
-                                   "epoch": self.epoch})
+        return self._with_rejoin("done",
+                                 {"host": self.host, "run": self.run_key,
+                                  "shard": int(shard),
+                                  "epoch": self.epoch})
 
 
 def _env_number(name: str, default, cast):
@@ -563,10 +1179,13 @@ def _env_number(name: str, default, cast):
         return default
 
 
-def client_for_storage(storage) -> Optional[SchedulerClient]:
-    """The scheduler client for one read, or None when the scheduler is
-    off (the default — ``scheduled_map_ordered`` then falls through to
-    the static path with zero extra work)."""
+def client_for_storage(storage,
+                       direction: str = "read"
+                       ) -> Optional[SchedulerClient]:
+    """The scheduler client for one read (or, with
+    ``direction="write"``, one write stage), or None when the scheduler
+    is off (the default — ``scheduled_map_ordered`` then falls through
+    to the static path with zero extra work)."""
     from disq_tpu.runtime.errors import DisqOptions
     from disq_tpu.runtime.multihost import process_id
 
@@ -585,6 +1204,10 @@ def client_for_storage(storage) -> Optional[SchedulerClient]:
     steal = bool(_env_number("DISQ_TPU_SCHED_STEAL",
                              1 if getattr(opts, "sched_steal", True) else 0,
                              int))
+    weight = _env_number("DISQ_TPU_SCHED_WEIGHT",
+                         getattr(opts, "sched_run_weight", 1.0), float)
+    failover_dir = (os.environ.get("DISQ_TPU_SCHED_FAILOVER")
+                    or getattr(opts, "sched_failover_dir", None))
     static_raw = os.environ.get("DISQ_TPU_SCHED_STATIC")
     static_of = None
     if static_raw:
@@ -594,14 +1217,35 @@ def client_for_storage(storage) -> Optional[SchedulerClient]:
         except ValueError:
             static_of = None
     serves = mode in ("serve", "1", "coordinator")
+    host = os.environ.get("DISQ_TPU_SCHED_HOST") or f"p{process_id()}"
     if serves:
         port = getattr(opts, "introspect_port", None)
-        address = serve_coordinator(lease_s=lease_s, port=port or 0)
+        address = serve_coordinator(lease_s=lease_s, port=port or 0,
+                                    failover_dir=failover_dir)
+        if failover_dir:
+            register_member(failover_dir, host, address)
+    elif mode == "auto":
+        if not failover_dir:
+            raise ValueError(
+                "scheduler='auto' discovers the coordinator through "
+                "the failover directory — set "
+                "DisqOptions.sched_failover_dir or "
+                "DISQ_TPU_SCHED_FAILOVER")
+        address = discover_coordinator(failover_dir)
     else:
         address = mode
-    host = os.environ.get("DISQ_TPU_SCHED_HOST") or f"p{process_id()}"
+    if failover_dir and not serves:
+        # Enroll in the standby electorate: this worker must be
+        # liveness-probeable (and able to host an adopted coordinator),
+        # so it serves the introspection plane too.
+        from disq_tpu.runtime.introspect import start_introspect_server
+
+        endpoint = start_introspect_server(0)
+        register_member(failover_dir, host, endpoint)
     return SchedulerClient(address, host, lease_n=lease_n, steal=steal,
-                           static_of=static_of, serves=serves)
+                           static_of=static_of, serves=serves,
+                           weight=weight, failover_dir=failover_dir,
+                           lease_s=lease_s, direction=direction)
 
 
 # ---------------------------------------------------------------------------
@@ -627,9 +1271,13 @@ def _cache_hints(fs, path: str) -> Optional[Dict[str, Any]]:
             "blocks": inner.cached_block_indices(path)}
 
 
-def run_key_for(path: str, n_shards: int) -> str:
+def run_key_for(path: str, n_shards: int,
+                direction: str = "read") -> str:
     salt = os.environ.get("DISQ_TPU_SCHED_SALT", "")
-    return f"{path}#{n_shards}" + (f"#{salt}" if salt else "")
+    key = f"{path}#{n_shards}" + (f"#{salt}" if salt else "")
+    # the write stage of a sorted save shares the coordinator with the
+    # read that feeds it — distinct keys keep the queues distinct
+    return key + "#write" if direction == "write" else key
 
 
 def scheduled_map_ordered(storage, fs, path: str, executor, tasks,
@@ -736,3 +1384,105 @@ def _scheduled_iter(client: SchedulerClient, storage, fs, path: str,
         # the coordinator host observed completion: commit the shared
         # ledger (spills dropped) exactly like the static path's finish
         ledger.finish()
+
+
+# ---------------------------------------------------------------------------
+# Write-direction leasing — what run_write_stage routes through when
+# the scheduler is armed and a StageManifest provides the durable side
+# ---------------------------------------------------------------------------
+
+
+def write_leasing_armed(storage) -> bool:
+    """Whether write stages should lease through the coordinator —
+    the same mode check ``client_for_storage`` makes, without building
+    a client (so the off path allocates nothing:
+    ``scripts/check_overhead.py`` asserts this stays False by
+    default)."""
+    opts = getattr(storage, "_options", None)
+    mode = getattr(opts, "scheduler", None) if opts is not None else None
+    if mode is None:
+        mode = os.environ.get("DISQ_TPU_SCHED") or None
+    return bool(mode)
+
+
+def scheduled_write_stage(storage, path: str, pipeline, n_shards: int,
+                          make_task, manifest,
+                          stage_name: str = "write.parts",
+                          retries: int = 1) -> List[Any]:
+    """``run_write_stage`` behind the shard scheduler: the write
+    stage's shards lease through the same coordinator as reads (run
+    key suffixed ``#write``, lease docs carry ``dir=write``) with the
+    shared ``StageManifest`` as the durable side.
+
+    Durability contract: every completed shard is ``mark_done``'d as
+    its part lands and the manifest is flushed (merge + atomic rename
+    + fsync) once per lease batch BEFORE the batch's ``/sched/done``
+    calls — so any shard the coordinator believes complete has a
+    durable manifest record, and a SIGKILL'd writer loses at most the
+    in-flight batch, whose shards expire back to survivors.  Stealing
+    is disabled in the write direction: a stolen write would stage the
+    same part twice concurrently; crash recovery goes through lease
+    expiry alone.  Returns the per-shard info list in shard order,
+    assembling other hosts' infos from the shared manifest."""
+    from dataclasses import replace
+
+    from disq_tpu.runtime.executor import _retrying, run_write_stage
+
+    client = client_for_storage(storage, direction="write")
+    if client is None:
+        return run_write_stage(pipeline, n_shards, make_task,
+                               manifest=manifest, stage_name=stage_name,
+                               retries=retries)
+    # several processes mark into one manifest file: merge-on-flush,
+    # and batch the rewrite+fsync behind a small interval
+    manifest.mark_shared(flush_interval_s=0.05)
+    client.join({
+        "key": run_key_for(path, n_shards, direction="write"),
+        "path": path,
+        "shards": {str(k): None for k in range(n_shards)},
+        "dir": "write",
+    })
+    # resume: report manifest-recorded shards done so they never lease
+    for k in range(n_shards):
+        if manifest.is_done(stage_name, k):
+            client.done(k)
+
+    def task_for(k: int):
+        task = make_task(k)
+        inner = _retrying(task.stage, retries)
+
+        def marked(payload, _inner=inner, _k=k):
+            info = _inner(payload) if _inner is not None else payload
+            manifest.mark_done(stage_name, _k, info)
+            return info
+
+        return replace(task, encode=_retrying(task.encode, retries),
+                       deflate=_retrying(task.deflate, retries),
+                       stage=marked)
+
+    idle = _IDLE_SLEEP_MIN_S
+    while True:
+        resp = client.lease()
+        if resp.get("error"):
+            raise IOError(
+                f"scheduler write lease failed: {resp['error']}")
+        ids = sorted(resp.get("shards") or [])
+        if not ids:
+            if resp.get("finished"):
+                break
+            record_span("sched.wait", idle)
+            time.sleep(idle)
+            idle = min(_IDLE_SLEEP_MAX_S, idle * 1.7)
+            continue
+        idle = _IDLE_SLEEP_MIN_S
+        fresh = [k for k in ids
+                 if not manifest.is_done(stage_name, k)]
+        for _res in pipeline.map_ordered([task_for(k) for k in fresh]):
+            pass  # infos are assembled from the manifest below
+        manifest.flush()  # durable BEFORE the coordinator learns
+        for k in ids:
+            client.done(k)
+    manifest.flush()
+    # other hosts' shard infos live only in the shared file
+    manifest.reload()
+    return [manifest.shard_info(stage_name, k) for k in range(n_shards)]

@@ -55,7 +55,11 @@ def _run_sam_shards(storage, fs, dataset, bounds, n_shards, prefix_bytes,
             what="sam.part",
         )
 
-    return run_write_stage(writer_for_storage(storage), n_shards, make_task)
+    # storage+path wired through for the scheduler's write-direction
+    # leasing gate (inert here: no StageManifest rides along)
+    return run_write_stage(writer_for_storage(storage), n_shards,
+                           make_task, storage=storage,
+                           path=part_path_for(0))
 
 
 class SamSink:

@@ -163,8 +163,11 @@ class VcfSink:
                 what="vcf.part",
             )
 
+        # storage+path flow through so an armed scheduler can lease the
+        # stage once a durable manifest rides along (none here today)
         infos = run_write_stage(
-            writer_for_storage(self._storage), n_shards, make_task)
+            writer_for_storage(self._storage), n_shards, make_task,
+            storage=self._storage, path=path)
         part_paths = [i["part"] for i in infos]
         part_lens = [i["len"] for i in infos]
         tbi_frags: List[TbiIndex] = [
@@ -256,7 +259,7 @@ class VcfSinkMultiple:
             )
 
         run_write_stage(writer_for_storage(self._storage), n_shards,
-                        make_task)
+                        make_task, storage=self._storage, path=path)
 
 
 def _lines_blob(part: VariantBatch) -> bytes:

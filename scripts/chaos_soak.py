@@ -812,6 +812,242 @@ def kill_leg(path, tmp) -> str:
     return postmortem_check(tmp)
 
 
+_COORD_KILL_CHILD = r"""
+import hashlib, json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from disq_tpu import ReadsStorage
+from disq_tpu.bam.source import BamSource, read_header
+from disq_tpu.fsw import (FaultInjectingFileSystemWrapper, FaultSpec,
+                          PosixFileSystemWrapper, register_filesystem)
+from disq_tpu.fsw.filesystem import resolve_path
+from disq_tpu.runtime import scheduler
+
+# A uniform slow tail on every range read keeps the pass in flight
+# long enough for the parent to SIGKILL the coordinator mid-pass.
+register_filesystem("fault", FaultInjectingFileSystemWrapper(
+    PosixFileSystemWrapper(),
+    [FaultSpec(kind="slow", probability=1.0, slow_s={slow_s})], seed=5))
+if os.environ["DISQ_TPU_SCHED"] == "serve":
+    # The coordinator host pre-serves and waits for the full
+    # electorate before decoding — otherwise interpreter-startup skew
+    # lets it drain the queue alone and there is no mid-pass to kill.
+    import time as _t
+    addr = scheduler.serve_coordinator(lease_s=2.0,
+                                       failover_dir={fdir!r})
+    scheduler.register_member({fdir!r}, "w0", addr)
+    mdir = os.path.join({fdir!r}, "members")
+    deadline = _t.monotonic() + 60
+    while _t.monotonic() < deadline:
+        try:
+            n = len([f for f in os.listdir(mdir)
+                     if f.endswith(".json")])
+        except OSError:
+            n = 0
+        if n >= 4:
+            break
+        _t.sleep(0.02)
+st = (ReadsStorage.make_default().split_size({split})
+      .read_ledger({ledger!r}))
+src = BamSource(st)
+fs, p = resolve_path("fault://" + {path!r})
+header, fv = read_header(fs, p)
+batches = src.read_split_batches(fs, p, header, fv)
+digests = {{}}
+for c, b in zip(src._last_counters, batches):
+    h = hashlib.sha1()
+    for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+        h.update(np.ascontiguousarray(getattr(b, f)).tobytes())
+    digests[str(c.shard_id)] = h.hexdigest()
+print(json.dumps({{"host": os.environ.get("DISQ_TPU_SCHED_HOST"),
+                   "took_over": scheduler.active_coordinator() is not None,
+                   "shards": digests}}))
+"""
+
+
+def coord_kill_leg(path, tmp) -> str:
+    """--coord-kill leg: a 4-worker scheduled read (w0 hosts the
+    coordinator, w1..w3 discover it via the failover directory) whose
+    coordinator process is SIGKILLed mid-pass.  Contract: the lowest
+    live process id (w1) must win the election, replay the journal and
+    resume the SAME epoch's complement — no ``run`` re-registration,
+    no shard emitted by two survivors, no journal-done shard decoded
+    again — and every surviving shard digest must match a fault-free
+    single-host read's."""
+    import hashlib
+    import json
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    import numpy as np
+
+    from disq_tpu import ReadsStorage
+    from disq_tpu.bam.source import BamSource, read_header
+    from disq_tpu.fsw.filesystem import resolve_path
+    from disq_tpu.runtime import scheduler
+    from disq_tpu.runtime.manifest import SchedJournal
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ck_tmp = os.path.join(tmp, "coord-kill")
+    os.makedirs(ck_tmp, exist_ok=True)
+    # A bigger fixture than the shared one: the kill window needs
+    # enough shards that "some done, most still pending" is a wide
+    # target, not a race (~26 splits at SPLIT=4096).
+    ck_path, _, _ = build_fixture(ck_tmp, 700, seed=23)
+    fdir = os.path.join(ck_tmp, "failover")
+    ldir = os.path.join(ck_tmp, "ledger")
+    os.makedirs(fdir, exist_ok=True)
+    jpath = os.path.join(fdir, "journal.jsonl")
+
+    # Fault-free single-host truth: per-shard digest table.
+    src = BamSource(ReadsStorage.make_default().split_size(SPLIT))
+    fs, p = resolve_path(ck_path)
+    header, fv = read_header(fs, p)
+    want = {}
+    batches = src.read_split_batches(fs, p, header, fv)
+    for c, b in zip(src._last_counters, batches):
+        h = hashlib.sha1()
+        for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+            h.update(np.ascontiguousarray(getattr(b, f)).tobytes())
+        want[str(c.shard_id)] = h.hexdigest()
+
+    def spawn(i):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "DISQ_TPU_SCHED": "serve" if i == 0 else "auto",
+               "DISQ_TPU_SCHED_FAILOVER": fdir,
+               "DISQ_TPU_SCHED_HOST": f"w{i}",
+               "DISQ_TPU_PROCESS_ID": str(i),
+               "DISQ_TPU_SCHED_LEASE_N": "1",
+               "DISQ_TPU_SCHED_LEASE_S": "2.0",
+               "DISQ_TPU_SCHED_STEAL": "0",
+               "DISQ_TPU_SCHED_SALT": "chaos-coord"}
+        return subprocess.Popen(
+            [_sys.executable, "-c", _COORD_KILL_CHILD.format(
+                repo=repo, path=ck_path, split=SPLIT, ledger=ldir,
+                fdir=fdir, slow_s=0.25)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+
+    coord = spawn(0)
+    # The coordinator must advertise before "auto" workers can
+    # discover it (they would wait 10s, but fail fast on a dead w0).
+    deadline = _time.monotonic() + 60
+    addr_path = os.path.join(fdir, "coordinator.addr")
+    while not os.path.exists(addr_path):
+        if coord.poll() is not None:
+            return ("coord-kill: coordinator child died before "
+                    "advertising: " + coord.communicate()[1][-800:])
+        if _time.monotonic() > deadline:
+            coord.kill()
+            return "coord-kill: coordinator never advertised"
+        _time.sleep(0.02)
+    workers = [spawn(i) for i in (1, 2, 3)]
+    procs = [coord] + workers
+
+    try:
+        # Kill window: all three survivors joined (they can rejoin and
+        # host an adopted coordinator) and the pass is genuinely
+        # mid-flight — some shards journaled done, most still pending.
+        total = 0
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            if coord.poll() is not None:
+                out, err = coord.communicate()
+                return ("coord-kill: coordinator child exited before "
+                        f"the kill window (rc={coord.returncode}): "
+                        + (err or out)[-800:])
+            recs = SchedJournal.load(jpath) \
+                if os.path.exists(jpath) else []
+            run = next((r for r in recs if r.get("op") == "run"), None)
+            total = len(run["shards"]) if run else 0
+            joined = {r.get("host") for r in recs
+                      if r.get("op") == "join"}
+            done_n = sum(1 for r in recs if r.get("op") == "done")
+            if (total >= 16 and {"w1", "w2", "w3"} <= joined
+                    and 3 <= done_n <= total - 8):
+                break
+            _time.sleep(0.02)
+        else:
+            return (f"coord-kill: never reached the kill window "
+                    f"(total={total})")
+        coord.send_signal(signal.SIGKILL)
+        coord.wait()
+
+        outs = []
+        for proc in workers:
+            out, err = proc.communicate(timeout=300)
+            if proc.returncode != 0:
+                return f"coord-kill: worker failed: {err[-800:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+    recs = SchedJournal.load(jpath)
+    # Same-epoch resume: replay preserved the run — a second "run"
+    # record would mean the survivors re-registered from scratch (and
+    # re-decoded the dead coordinator's finished shards).
+    if sum(1 for r in recs if r.get("op") == "run") != 1:
+        return ("coord-kill: run re-registered after the failover — "
+                "journal replay lost the pass")
+    # The FIRST takeover must be the election winner (lowest live
+    # process id = w1).  Later takeovers are legitimate: the adopting
+    # worker exits when its own read drains, and a still-working
+    # survivor re-elects — the rejoin flag keeps those no-ops (the
+    # run-count check above proves no takeover restarted the pass).
+    takeovers = [r.get("host") for r in recs
+                 if r.get("op") == "takeover"]
+    if not takeovers or takeovers[0] != "w1":
+        return (f"coord-kill: first takeover should be w1 "
+                f"(lowest live process id), got {takeovers}")
+    adopters = sorted(o["host"] for o in outs if o["took_over"])
+    if "w1" not in adopters:
+        return f"coord-kill: w1 never adopted the coordinator"
+
+    # Exactly-once over the complement: shards the dead coordinator
+    # journaled done stay done; everything else is emitted by exactly
+    # one survivor with a truth-identical digest.
+    w0_done = {str(r["shard"]) for r in recs
+               if r.get("op") == "done" and r.get("host") == "w0"}
+    got = {}
+    for doc in outs:
+        for sid, dig in doc["shards"].items():
+            if sid in got:
+                return (f"coord-kill: shard {sid} emitted by two "
+                        f"survivors")
+            got[sid] = dig
+    expect = {sid: dig for sid, dig in want.items()
+              if sid not in w0_done}
+    if got != expect:
+        missing = sorted(set(expect) - set(got), key=int)
+        redone = sorted(set(got) & w0_done, key=int)
+        wrong = sorted((k for k in got if expect.get(k) != got[k]
+                        and k in expect), key=int)
+        return (f"coord-kill: complement digests diverge "
+                f"(missing={missing}, redecoded-done={redone}, "
+                f"wrong={wrong})")
+
+    # The final journal must replay to a drained queue: every shard
+    # done, nothing pending or leased — the state a fresh standby
+    # would inherit.
+    fp = scheduler.replay_journal(recs, lease_s=2.0).state_fingerprint()
+    run_fp = next((r for k, r in fp["runs"].items()
+                   if "chaos-coord" in k), None)
+    if run_fp is None:
+        return "coord-kill: replayed journal lost the run"
+    if run_fp["pending"] or run_fp["leases"] \
+            or len(run_fp["done"]) != len(want):
+        return (f"coord-kill: replayed end state not drained "
+                f"(pending={run_fp['pending']}, "
+                f"leases={sorted(run_fp['leases'])}, "
+                f"done={len(run_fp['done'])}/{len(want)})")
+    return ""
+
+
 def serve_leg(path, tmp) -> str:
     """Tenant storm against the serving plane (runtime/serve.py): four
     good tenants issue concurrent region queries through injected
@@ -1029,6 +1265,14 @@ def main(argv=None) -> int:
                          "all succeed with truthful counts, the "
                          "abusive tenant must shed with 429s, and "
                          "serve.admission{result=shed} must be booked")
+    ap.add_argument("--coord-kill", action="store_true",
+                    help="run the coordinator-failover leg: a 4-worker "
+                         "scheduled read whose coordinator process is "
+                         "SIGKILLed mid-pass; the lowest live process "
+                         "id must take over by replaying the journal "
+                         "and the survivors must finish the same "
+                         "epoch's complement exactly once, digest-"
+                         "identical to a single-host read")
     ap.add_argument("--kill", action="store_true",
                     help="run the crash-resume leg: SIGKILL a writer "
                          "subprocess mid-run, resume from its "
@@ -1091,6 +1335,11 @@ def main(argv=None) -> int:
         if args.steal:
             err = steal_leg(path, tmp)
             print(f"[steal] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.coord_kill:
+            err = coord_kill_leg(path, tmp)
+            print(f"[coord-kill] {'ok' if not err else 'FAIL: ' + err}")
             if err:
                 failures.append((args.seed, err))
         if args.kill:

@@ -165,6 +165,27 @@ def main() -> int:
            for t in threading.enumerate()):
         errors.append(
             "stray scheduler thread on the disabled path")
+    # failover off must keep the PR 12 guarantee exactly: no journal
+    # object (⇒ no journal file is ever created), no standby machinery,
+    # and the write path never consults the coordinator
+    if os.environ.get("DISQ_TPU_SCHED_FAILOVER"):
+        errors.append(
+            "DISQ_TPU_SCHED_FAILOVER leaked into the guard's env — the "
+            "default path must not arm coordinator failover")
+    if scheduler.active_journal() is not None:
+        errors.append(
+            "a scheduler journal exists with failover off — the "
+            "default path must write no journal file")
+    if scheduler.write_leasing_armed(_Storage()):
+        errors.append(
+            "write_leasing_armed(default storage) is True — write "
+            "stages would RPC on the default path")
+    if any(t.name.startswith(("disq-standby", "disq-failover"))
+           for t in threading.enumerate()):
+        errors.append(
+            "stray failover standby thread on the disabled path — "
+            "election must be lazy (probe on RPC failure), never a "
+            "resident thread")
 
     # -- 1b4. serving plane: off ⇒ no daemon, caches or admission state ------
     from disq_tpu.runtime import serve as serve_plane

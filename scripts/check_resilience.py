@@ -18,6 +18,14 @@ Three invariant families, each cheap enough for CI:
    every resilience knob off spawns no ``disq-hedge`` thread and no
    timer; and a read with hedging *on* produces records byte-identical
    to the seed path (hedging may change timing, never bytes).
+4. **Journal replay is exact.** Drive a journaled ``ShardCoordinator``
+   through an adversarial schedule (joins, leases, completions, lease
+   expiry, steals, a finished-then-restarted pass) and replay the
+   recorded ``SchedJournal`` with the pure ``replay_journal``: the
+   replayed ``state_fingerprint()`` must equal the live coordinator's
+   EXACTLY — the invariant coordinator failover stands on.  A torn
+   tail line must degrade to "replay the surviving prefix", never to a
+   crash.
 
 Run directly: ``python scripts/check_resilience.py`` (exit 0 ok).
 """
@@ -212,18 +220,88 @@ def check_disabled_path(errors):
     reset_resilience()
 
 
+def check_journal_replay(errors):
+    """Replaying a recorded SchedJournal must reproduce the live
+    coordinator's final lease table exactly (pure-function replay —
+    the standby-promotion invariant)."""
+    import json
+    import tempfile
+
+    from disq_tpu.runtime.manifest import SchedJournal
+    from disq_tpu.runtime.scheduler import (
+        ShardCoordinator,
+        replay_journal,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="sched-journal-") as tmp:
+        jpath = os.path.join(tmp, "journal.jsonl")
+        journal = SchedJournal(jpath)
+        now = [0.0]
+        coord = ShardCoordinator(lease_s=10.0, clock=lambda: now[0],
+                                 journal=journal)
+        table = {str(i): ([i * 100, i * 100 + 100] if i % 2 else None)
+                 for i in range(8)}
+        # an adversarial schedule: two hosts, expiry, a steal, a dup
+        # done, a second run contending, and a finished pass restarted
+        coord.join("A", {"key": "r1", "path": "p1", "shards": table})
+        coord.join("B", {"key": "r1", "path": "p1", "shards": table})
+        coord.join("B", {"key": "r2", "path": "p2", "weight": 3.0,
+                         "shards": {str(i): None for i in range(4)}})
+        coord.lease("A", "r1", want=3)
+        now[0] = 1.0
+        coord.lease("B", "r1", want=2)
+        coord.lease("B", "r2", want=2)
+        coord.done("A", "r1", 0)
+        coord.done("B", "r1", 0)      # lost race: dup done, no record
+        now[0] = 12.0
+        coord.lease("B", "r1", want=1)  # sweeps: expiries requeue
+        coord.steal("A", "r1")
+        for s in range(4):
+            coord.done("B", "r2", s, epoch=1)
+        coord.join("B", {"key": "r2", "path": "p2", "weight": 3.0,
+                         "shards": {str(i): None for i in range(4)}})
+        journal.sync()
+
+        records = SchedJournal.load(jpath)
+        if not records:
+            errors.append("journaled coordinator wrote no records")
+            return
+        live = coord.state_fingerprint()
+        replayed = replay_journal(records, lease_s=10.0
+                                  ).state_fingerprint()
+        if replayed != live:
+            errors.append(
+                "journal replay diverged from the live coordinator:\n"
+                f"    live:     {json.dumps(live, sort_keys=True)}\n"
+                f"    replayed: {json.dumps(replayed, sort_keys=True)}")
+        # a torn tail (crash mid-append) replays the surviving prefix
+        with open(jpath, "a") as f:
+            f.write('{"op": "done", "key": "r1", "hos')
+        torn = SchedJournal.load(jpath)
+        if len(torn) != len(records):
+            errors.append(
+                f"torn journal tail not skipped: {len(torn)} records "
+                f"loaded, expected {len(records)}")
+        if replay_journal(torn, lease_s=10.0
+                          ).state_fingerprint() != live:
+            errors.append("torn-tail replay diverged from the live "
+                          "coordinator")
+
+
 def main() -> int:
     errors = []
     check_breaker_totality(errors)
     check_hedge_accounting(errors)
     check_disabled_path(errors)
+    check_journal_replay(errors)
     if errors:
         print(f"check_resilience: {len(errors)} problem(s)")
         for e in errors:
             print(f"  - {e}")
         return 1
     print("check_resilience: OK (breaker machine total, hedge "
-          "accounting balanced, disabled path clean)")
+          "accounting balanced, disabled path clean, journal replay "
+          "exact)")
     return 0
 
 

@@ -416,7 +416,12 @@ ADVICE = {
     "sched": "scheduler RPC overhead dominates: raise sched_lease_n "
              "so each lease round carries more shards, or shrink the "
              "shard count (bigger split_size) — the queue is being "
-             "polled more than it is worked",
+             "polled more than it is worked; if "
+             "sched.failover.rediscoveries is nonzero the time went "
+             "into coordinator loss instead — check "
+             "sched.failover.takeovers{host=} for who replayed the "
+             "journal, and sched.quota.deferred for lease rounds the "
+             "fairness quota trimmed under multi-run contention",
     "steal": "work-stealing wait dominates: this host idled while "
              "another held stale leases — lower sched_lease_n so "
              "stragglers hold fewer shards at a time, lower "

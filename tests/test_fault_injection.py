@@ -293,7 +293,11 @@ def test_chaos_soak_smoke(executor_workers):
     (SIGKILL a writer mid-run, ledger-asserted resume), --steal
     (2-subprocess scheduled read with one slowed worker: the fast
     worker must steal a stale lease, every shard emits exactly once,
-    digests match a single-host read), and --serve (tenant storm
+    digests match a single-host read), --coord-kill (SIGKILL the
+    coordinator process mid-pass: the lowest live process id replays
+    the journal, the survivors finish the same epoch's complement
+    exactly once, digest-identical to a single-host read), and --serve
+    (tenant storm
     against the serving plane under transient read faults: good
     tenants succeed with truthful counts, the abusive tenant sheds
     with 429s and serve.admission{result=shed} is booked)."""
@@ -305,7 +309,7 @@ def test_chaos_soak_smoke(executor_workers):
          "--seed", "7", "--executor-workers", str(executor_workers),
          "--writer-workers", str(executor_workers),
          "--hedge", "--breaker", "--resident", "--device-write",
-         "--steal", "--kill", "--serve"]
+         "--steal", "--kill", "--coord-kill", "--serve"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},

@@ -585,3 +585,545 @@ class TestKillHandoff:
             batch, _stats = ledger.load(int(s))
             assembled[s] = _digest(batch)
         assert assembled == truth
+
+
+class TestFairness:
+    """Multi-run fairness: weighted max-min lease quotas
+    (``DisqOptions.sched_run_weight`` / ``DISQ_TPU_SCHED_WEIGHT``)."""
+
+    def test_single_run_never_throttled(self):
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c, n=8)
+        assert len(c.lease("A", "k", want=8)["shards"]) == 8
+
+    def test_weighted_run_holds_quota_share_under_saturating_batch(self):
+        """Acceptance: a weight-3 interactive run keeps >= its weighted
+        share of in-flight leases while a weight-1 batch run tries to
+        saturate the coordinator, and both quota counters book."""
+        from disq_tpu.runtime.tracing import counter
+
+        g0 = counter("sched.quota.granted").total()
+        d0 = counter("sched.quota.deferred").total()
+        c = ShardCoordinator(clock=FakeClock())
+        c.join("B", {"key": "batch", "path": "p1",
+                     "shards": {str(i): None for i in range(16)}})
+        c.join("L", {"key": "live", "path": "p2", "weight": 3.0,
+                     "shards": {str(i): None for i in range(16)}})
+        # the batch run asks for everything first: capped to its
+        # weighted share (1 of 4) of what would be in flight
+        rb = c.lease("B", "batch", want=16)
+        assert len(rb["shards"]) == 4
+        # the interactive run then gets >= its 3-of-4 share
+        rl = c.lease("L", "live", want=16)
+        assert len(rl["shards"]) == 15
+        total = len(rb["shards"]) + len(rl["shards"])
+        assert len(rl["shards"]) / total >= 3.0 / 4.0
+        assert counter("sched.quota.granted").total() - g0 == 19
+        assert counter("sched.quota.deferred").total() - d0 == 13
+        # the batch run is deferred, not starved: completions free
+        # quota and its next lease progresses
+        for s in rb["shards"]:
+            c.done("B", "batch", s)
+        assert len(c.lease("B", "batch", want=4)["shards"]) >= 1
+
+    def test_every_run_keeps_at_least_one_lease(self):
+        """Starvation-freedom: even a near-zero-weight run can always
+        hold one lease."""
+        c = ShardCoordinator(clock=FakeClock())
+        c.join("G", {"key": "big", "path": "p", "weight": 1000.0,
+                     "shards": {str(i): None for i in range(32)}})
+        c.join("T", {"key": "tiny", "path": "p2", "weight": 0.001,
+                     "shards": {str(i): None for i in range(4)}})
+        c.lease("G", "big", want=32)
+        assert len(c.lease("T", "tiny", want=4)["shards"]) >= 1
+
+    def test_quota_disengages_when_contender_finishes(self):
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c, host="A", n=4, key="r1")
+        c.join("B", {"key": "r2", "path": "p2",
+                     "shards": {str(i): None for i in range(4)}})
+        for s in range(4):
+            c.lease("B", "r2", want=1)
+            c.done("B", "r2", s)
+        # r2 finished: r1 is alone and gets the whole queue again
+        assert len(c.lease("A", "r1", want=4)["shards"]) == 4
+
+
+class TestWriteLeaseDirection:
+    def test_direction_mismatch_is_an_error(self):
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c)  # registers a read-direction run
+        r = c.lease("A", "k", want=1, direction="write")
+        assert "error" in r
+        c.join("A", {"key": "w", "path": "p", "dir": "write",
+                     "shards": {"0": None}})
+        assert "error" in c.lease("A", "w", want=1, direction="read")
+        assert c.lease("A", "w", want=1,
+                       direction="write")["shards"] == [0]
+
+
+class TestJournalReplay:
+    def test_journal_roundtrip_and_torn_tail(self, tmp_path):
+        from disq_tpu.runtime.manifest import SchedJournal
+
+        jp = str(tmp_path / "j.jsonl")
+        j = SchedJournal(jp)
+        j.append("run", key="k", t=0.0)
+        j.append("lease", key="k", host="A", shards=[0], t=1.0)
+        j.sync()
+        j.close()
+        assert [r["op"] for r in SchedJournal.load(jp)] == [
+            "run", "lease"]
+        # a crash mid-append tears the final line: load() skips it
+        with open(jp, "a") as f:
+            f.write('{"op": "done", "ho')
+        assert len(SchedJournal.load(jp)) == 2
+        # a successor REOPENING the torn journal must not lose its
+        # first append into the torn line (the takeover record)
+        j2 = SchedJournal(jp)
+        j2.append("takeover", host="B", pid=1)
+        j2.close()
+        recs = SchedJournal.load(jp)
+        assert recs[-1] == {"op": "takeover", "host": "B", "pid": 1}
+
+    def test_foreign_journal_set_aside_not_replayed(self, tmp_path):
+        from disq_tpu.runtime.manifest import SchedJournal
+
+        jp = str(tmp_path / "j.jsonl")
+        with open(jp, "w") as f:
+            f.write("not a journal\n")
+        assert SchedJournal.load(jp) == []
+        j = SchedJournal(jp)
+        j.append("run", key="k", t=0.0)
+        j.close()
+        assert [r["op"] for r in SchedJournal.load(jp)] == ["run"]
+        assert os.path.exists(jp + ".bak")
+
+    def test_replay_reproduces_live_fingerprint(self, tmp_path):
+        """The failover invariant in miniature (check_resilience.py
+        drives the adversarial version): journal a live schedule,
+        replay it pure, compare canonical state."""
+        from disq_tpu.runtime.manifest import SchedJournal
+        from disq_tpu.runtime.scheduler import replay_journal
+
+        jp = str(tmp_path / "j.jsonl")
+        journal = SchedJournal(jp)
+        clock = FakeClock()
+        c = ShardCoordinator(lease_s=5.0, clock=clock, journal=journal)
+        register_run(c, host="A")
+        register_run(c, host="B")
+        c.lease("A", "k", want=2)
+        clock.t = 1.0
+        c.lease("B", "k", want=2)
+        c.done("A", "k", 0)
+        clock.t = 6.0
+        c.lease("B", "k", want=1)  # sweeps: A's stale lease requeues
+        journal.sync()
+        replayed = replay_journal(SchedJournal.load(jp), lease_s=5.0)
+        assert replayed.state_fingerprint() == c.state_fingerprint()
+
+    def test_rejoin_never_restarts_a_finished_pass(self):
+        """The standby-promotion hazard the chaos leg caught: a worker
+        rejoining after a coordinator handoff must NOT re-register a
+        finished run (that would re-decode every shard), while a plain
+        same-input join still starts a fresh pass."""
+        c = ShardCoordinator(clock=FakeClock())
+        register_run(c)
+        for s in range(6):
+            c.lease("A", "k", want=1)
+            c.done("A", "k", s)
+        assert c.stats()["runs"]["k"]["finished"]
+        doc = {"key": "k", "path": "p",
+               "shards": {str(i): [i * 100, (i + 1) * 100]
+                          for i in range(6)}}
+        r = c.join("A", doc, rejoin=True)
+        assert not r["registered"] and r["epoch"] == 1
+        assert c.lease("A", "k")["finished"]
+        assert register_run(c)["registered"]  # a NEW read still does
+
+
+class TestFailoverPlane:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from disq_tpu.runtime.introspect import reset_introspection
+
+        yield
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+    def test_advertise_discover_roundtrip(self, tmp_path):
+        fdir = str(tmp_path / "fo")
+        scheduler.advertise_coordinator(fdir, "127.0.0.1:12345")
+        assert scheduler.discover_coordinator(fdir) == "127.0.0.1:12345"
+        with pytest.raises(IOError):
+            scheduler.discover_coordinator(str(tmp_path / "empty"),
+                                           wait_s=0.1)
+
+    def test_done_after_coordinator_restart_rejoins_then_wins(self):
+        """Satellite: /sched/done answered "unknown run" (coordinator
+        restarted) must rejoin-then-done client-side, not crash the
+        worker."""
+        addr = scheduler.serve_coordinator()
+        cl = SchedulerClient(addr, "hA", lease_n=2)
+        cl.join({"key": "r", "path": "p",
+                 "shards": {str(i): None for i in range(3)}})
+        assert cl.lease()["shards"] == [0, 1]
+        scheduler.stop_coordinator()
+        scheduler.serve_coordinator()  # same endpoint, blank state
+        d = cl.done(0)
+        assert "error" not in d and d["won"]
+        stats = scheduler.active_coordinator().stats()
+        assert stats["runs"]["r"]["done"]["0"] == "hA"
+
+    def test_client_rediscovers_readvertised_coordinator(self, tmp_path):
+        """The worker side of failover without an election: the old
+        endpoint dies, a new coordinator advertises, and the client's
+        next RPC lands there via the failover directory."""
+        from disq_tpu.runtime.introspect import reset_introspection
+        from disq_tpu.runtime.tracing import counter
+
+        fdir = str(tmp_path / "fo")
+        addr1 = scheduler.serve_coordinator(lease_s=5.0,
+                                            failover_dir=fdir)
+        cl = SchedulerClient(addr1, "hA", lease_n=2, failover_dir=fdir,
+                             lease_s=5.0)
+        cl.join({"key": "r", "path": "p",
+                 "shards": {str(i): None for i in range(4)}})
+        assert cl.lease()["shards"] == [0, 1]
+        r0 = counter("sched.failover.rediscoveries").total()
+        scheduler.stop_coordinator()
+        reset_introspection()  # the endpoint itself goes away
+        addr2 = scheduler.serve_coordinator(lease_s=5.0,
+                                            failover_dir=fdir)
+        assert addr2 != addr1
+        d = cl.done(0)  # dead endpoint -> rediscover -> rejoin -> win
+        assert "error" not in d and d["won"]
+        assert cl.address == addr2
+        assert counter("sched.failover.rediscoveries").total() > r0
+
+    def test_coordinator_lost_error_is_transient(self):
+        from disq_tpu.runtime.errors import (
+            CoordinatorLostError, is_transient)
+
+        err = CoordinatorLostError("scheduler coordinator lost",
+                                   address="x:1", op="lease")
+        assert is_transient(err)
+        assert "x:1" in str(err) and "lease" in str(err)
+
+_COORD_SERVER = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from disq_tpu.runtime import scheduler
+
+# Coordinator-only process: serves the control plane (journal in the
+# failover dir), registers in the electorate, and never decodes a
+# byte — so when it dies, every shard digest must come from the
+# standby's own pass.
+addr = scheduler.serve_coordinator(lease_s=1.5, failover_dir={fdir!r})
+scheduler.register_member({fdir!r}, "coord", addr)
+print("up", flush=True)
+time.sleep(600)
+"""
+
+_FAILOVER_WORKER = r"""
+import hashlib, json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from disq_tpu import ReadsStorage
+from disq_tpu.bam.source import BamSource, read_header
+from disq_tpu.fsw.filesystem import resolve_path
+from disq_tpu.runtime import scheduler
+
+# Slow every decode so the parent can SIGKILL the coordinator while
+# this worker is mid-pass with a live lease table to replay.
+_orig = BamSource._decode_fetched
+
+def _slowed(self, header, fetched, ctx=None):
+    time.sleep(0.08)
+    return _orig(self, header, fetched, ctx=ctx)
+
+BamSource._decode_fetched = _slowed
+st = (ReadsStorage.make_default().split_size({split})
+      .read_ledger({ledger!r}))
+src = BamSource(st)
+fs, p = resolve_path({path!r})
+header, fv = read_header(fs, p)
+batches = src.read_split_batches(fs, p, header, fv)
+digests = {{}}
+for c, b in zip(src._last_counters, batches):
+    h = hashlib.sha1()
+    for f in ("refid", "pos", "flag", "seqs", "quals", "names"):
+        h.update(np.ascontiguousarray(getattr(b, f)).tobytes())
+    digests[str(c.shard_id)] = h.hexdigest()
+print(json.dumps({{"took_over": scheduler.active_coordinator() is not None,
+                   "shards": digests}}))
+"""
+
+
+class TestCoordinatorFailover:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from disq_tpu.runtime.introspect import reset_introspection
+
+        yield
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+    def test_coordinator_sigkill_standby_replays_and_finishes(
+            self, tmp_path):
+        """Acceptance: SIGKILL the coordinator PROCESS mid-pass.  The
+        standby (lowest live process id) must win the election, replay
+        the journal, and finish the SAME pass — exactly-once done
+        accounting and output byte-identical to a single-host read."""
+        from disq_tpu.api import ReadsStorage
+        from disq_tpu.bam.source import BamSource, read_header
+        from disq_tpu.fsw.filesystem import resolve_path
+        from disq_tpu.runtime.manifest import SchedJournal
+
+        split = 4096
+        path = _fixture(tmp_path, n=600, seed=5)
+        fdir = str(tmp_path / "failover")
+        ledger = str(tmp_path / "ledger")
+        jpath = os.path.join(fdir, "journal.jsonl")
+
+        src0 = BamSource(ReadsStorage.make_default().split_size(split))
+        fs0, p0 = resolve_path(path)
+        header, fv = read_header(fs0, p0)
+        truth = {}
+        truth_batches = src0.read_split_batches(fs0, p0, header, fv)
+        for c, b in zip(src0._last_counters, truth_batches):
+            truth[str(c.shard_id)] = _digest(b)
+        assert len(truth) >= 12, "fixture too small for a kill window"
+
+        coord = subprocess.Popen(
+            [sys.executable, "-c",
+             _COORD_SERVER.format(repo=REPO, fdir=fdir)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "DISQ_TPU_PROCESS_ID": "9"})
+        worker = None
+        try:
+            addr1 = scheduler.discover_coordinator(fdir, wait_s=60)
+            env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "DISQ_TPU_SCHED": "auto",
+                   "DISQ_TPU_SCHED_FAILOVER": fdir,
+                   "DISQ_TPU_SCHED_HOST": "standby",
+                   "DISQ_TPU_PROCESS_ID": "1",
+                   "DISQ_TPU_SCHED_LEASE_N": "1",
+                   "DISQ_TPU_SCHED_LEASE_S": "1.5",
+                   "DISQ_TPU_SCHED_STEAL": "0"}
+            worker = subprocess.Popen(
+                [sys.executable, "-c", _FAILOVER_WORKER.format(
+                    repo=REPO, split=split, path=path, ledger=ledger)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+
+            # kill window: the standby has joined and completed a few
+            # shards, with plenty of the pass still pending
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if coord.poll() is not None:
+                    pytest.fail("coordinator exited early: "
+                                + coord.stderr.read().decode()[-500:])
+                if worker.poll() is not None:
+                    pytest.fail("worker finished before the kill: "
+                                + worker.stderr.read()[-500:])
+                recs = (SchedJournal.load(jpath)
+                        if os.path.exists(jpath) else [])
+                joined = {r["host"] for r in recs if r["op"] == "join"}
+                dones = sum(1 for r in recs if r["op"] == "done")
+                if "standby" in joined and 3 <= dones <= len(truth) - 6:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("never reached the kill window")
+            coord.send_signal(signal.SIGKILL)
+            coord.wait()
+
+            out, err = worker.communicate(timeout=240)
+            assert worker.returncode == 0, err[-1000:]
+        finally:
+            for proc in (coord, worker):
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+        doc = json.loads(out.strip().splitlines()[-1])
+
+        # the worker ended the pass hosting the adopted coordinator
+        assert doc["took_over"]
+        # byte identity — and the dead coordinator never decoded, so
+        # every digest is the standby's own
+        assert doc["shards"] == truth
+
+        recs = SchedJournal.load(jpath)
+        # same pass throughout: a failover rejoin must never
+        # re-register (= restart) the run
+        assert sum(1 for r in recs if r["op"] == "run") == 1
+        takeovers = [r for r in recs if r["op"] == "takeover"]
+        assert takeovers and takeovers[0]["host"] == "standby"
+        # exactly-once accounting across the handoff
+        done_shards = [r["shard"] for r in recs if r["op"] == "done"
+                       and r.get("won", True)]
+        assert len(done_shards) == len(set(done_shards)) == len(truth)
+        # the replayed end state is a drained queue
+        fp = scheduler.replay_journal(recs, lease_s=1.5)
+        run = next(iter(fp.state_fingerprint()["runs"].values()))
+        assert not run["pending"] and not run["leases"]
+        assert len(run["done"]) == len(truth)
+
+
+_WRITE_LEASE_VICTIM = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from disq_tpu import DisqOptions, ReadsStorage
+from disq_tpu.api import StageManifestWriteOption
+from disq_tpu.fsw import (FaultInjectingFileSystemWrapper, FaultSpec,
+                          PosixFileSystemWrapper, register_filesystem)
+
+# Wedge the 4th write-side call for 300s: a couple of leased parts
+# land (manifest + coordinator both record them), then the writer
+# hangs holding live WRITE leases until SIGKILL.
+register_filesystem("fault", FaultInjectingFileSystemWrapper(
+    PosixFileSystemWrapper(),
+    [FaultSpec(kind="stall", op="write", stall_s=300.0, call_index=3,
+               times=1)]))
+ds = ReadsStorage.make_default().split_size({split}).read({path!r})
+st = (ReadsStorage.make_default().num_shards(6)
+      .options(DisqOptions(retry_backoff_s=0.0))
+      .writer_workers(2))
+st.write(ds, "fault://" + {out!r}, StageManifestWriteOption({mpath!r}))
+os._exit(3)  # unreachable: the wedge outlives the SIGKILL
+"""
+
+
+class TestWriteLeasing:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from disq_tpu.fsw import (FaultInjectingFileSystemWrapper,
+                                  PosixFileSystemWrapper,
+                                  register_filesystem)
+        from disq_tpu.runtime.introspect import reset_introspection
+
+        yield
+        register_filesystem("fault", FaultInjectingFileSystemWrapper(
+            PosixFileSystemWrapper(), []))
+        scheduler.stop_coordinator()
+        reset_introspection()
+
+    def test_write_lease_sigkill_staged_parts_survive(self, tmp_path):
+        """Acceptance: SIGKILL a writer holding write-direction
+        leases.  Its staged parts survive via the StageManifest, the
+        coordinator re-queues only the unfinished shards, and the
+        resumed writer stages exactly that complement — bytes
+        identical to a fault-free run."""
+        from disq_tpu import StageManifest
+        from disq_tpu.api import ReadsStorage, StageManifestWriteOption
+        from disq_tpu.fsw import (FaultInjectingFileSystemWrapper,
+                                  PosixFileSystemWrapper,
+                                  register_filesystem)
+
+        split = 4096
+        raw = _fixture(tmp_path, n=1500, seed=3)
+        out = str(tmp_path / "leased.bam")
+        mpath = str(tmp_path / "leased.manifest")
+        addr = scheduler.serve_coordinator(lease_s=0.9)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "DISQ_TPU_SCHED": addr, "DISQ_TPU_SCHED_HOST": "victim",
+               "DISQ_TPU_SCHED_LEASE_N": "2",
+               "DISQ_TPU_SCHED_STEAL": "0"}
+        victim = subprocess.Popen(
+            [sys.executable, "-c", _WRITE_LEASE_VICTIM.format(
+                repo=REPO, split=split, path=raw, out=out,
+                mpath=mpath)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env)
+
+        deadline = time.monotonic() + 120
+        staged_n = 0
+        while time.monotonic() < deadline:
+            if victim.poll() is not None:
+                pytest.fail("victim exited early: "
+                            + victim.stderr.read().decode()[-800:])
+            try:
+                with open(mpath) as f:
+                    state = json.load(f)
+                staged_n = len(state.get("stages", {}).get(
+                    "bam.parts", {}).get("shards", {}))
+            except (OSError, json.JSONDecodeError, ValueError):
+                staged_n = 0
+            if staged_n >= 2:
+                break
+            time.sleep(0.05)
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        assert staged_n >= 2, "victim never staged 2 shards"
+
+        manifest = StageManifest(mpath)
+        pre_done = set(manifest.completed_shards("bam.parts"))
+        assert len(pre_done) >= 2
+
+        # the run leased through the WRITE direction of the shared
+        # coordinator, and the victim's completions were booked there
+        wkey = scheduler.run_key_for("fault://" + out, 6,
+                                     direction="write")
+        run = scheduler.active_coordinator().stats()["runs"][wkey]
+        assert run["dir"] == "write"
+        assert {int(s) for s in run["done"]} >= pre_done
+
+        # resume on the SAME coordinator through a write-logging fs:
+        # completed shards must NOT re-stage, the rest must
+        class _Counting(PosixFileSystemWrapper):
+            writes = []
+
+            def write_all(self, p, data):
+                _Counting.writes.append(p)
+                super().write_all(p, data)
+
+        register_filesystem("fault", FaultInjectingFileSystemWrapper(
+            _Counting(), []))
+        ds = ReadsStorage.make_default().split_size(split).read(raw)
+        st = (ReadsStorage.make_default().num_shards(6)
+              .scheduler(addr, lease_n=2, lease_s=0.9, steal=False)
+              .writer_workers(2))
+        st.write(ds, "fault://" + out, StageManifestWriteOption(mpath))
+
+        staged = {int(p.rsplit("part-", 1)[1][:5])
+                  for p in _Counting.writes if "part-" in p}
+        assert not (staged & pre_done), (
+            f"resume re-staged completed shards {staged & pre_done}")
+        assert staged == set(range(6)) - pre_done
+        assert not os.path.exists(mpath), "manifest outlived the commit"
+        run = scheduler.active_coordinator().stats()["runs"][wkey]
+        assert run["finished"]
+        assert {int(s) for s in run["done"]} == set(range(6))
+
+        clean = str(tmp_path / "clean.bam")
+        ReadsStorage.make_default().num_shards(6).write(ds, clean)
+        with open(out, "rb") as fa, open(clean, "rb") as fb:
+            assert fa.read() == fb.read()
+
+    def test_torn_response_body_lands_in_the_failover_ladder(
+            self, monkeypatch):
+        """A coordinator SIGKILLed mid-response-body surfaces as
+        http.client.IncompleteRead from resp.read() — an HTTPException,
+        NOT an OSError — and must still come out of the RPC layer as
+        the IOError the failover ladder catches, not kill the worker."""
+        import http.client
+
+        class _TornResp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                raise http.client.IncompleteRead(b"", 67)
+
+        monkeypatch.setattr(scheduler.urllib.request, "urlopen",
+                            lambda *a, **k: _TornResp())
+        monkeypatch.setattr(scheduler, "_RPC_BACKOFF_S", 0.0)
+        cl = SchedulerClient("127.0.0.1:1", "hA")
+        with pytest.raises(IOError, match="unreachable"):
+            cl._call_once("lease", {})
