@@ -769,6 +769,86 @@ def device_write_config(path: str, tmp: str) -> dict:
     return {"11_device_write": rows}
 
 
+def mesh_pipeline_config(path: str) -> dict:
+    """Config 14: the mesh-native device pipeline (``runtime/mesh.py``)
+    — decode + coordinate sort + flagstat as ONE sharded program over
+    the batch-axis mesh, at 1/2/4/8 devices (clamped to what the host
+    has) — real chip only.
+
+    The n_devices=1 row is the plain single-device resident pipeline
+    (the mesh knob's off path), so every multi-chip row reads as a
+    scaling factor against it.  Each mesh row carries the psum/all_to_all
+    exchange bytes and mesh reshard bytes from ``device.mesh.*``
+    registry deltas, plus the decode service's per-device
+    ``device.lane_fill`` means — the dispatcher must fill ALL chips'
+    lanes, not device 0's.  Output equality is asserted inside the
+    timed body (flagstat total + sorted count), so a wrong mesh program
+    can never post a throughput number."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from disq_tpu import ReadsStorage
+    from disq_tpu.runtime import device_service
+    from disq_tpu.runtime.mesh import _MESH_CACHE
+    from disq_tpu.runtime.tracing import REGISTRY
+
+    total_bytes = os.path.getsize(path)
+    exch = REGISTRY.counter("device.mesh.exchange_bytes")
+    resh = REGISTRY.counter("device.mesh.reshard_bytes")
+    rows: dict = {}
+    n_avail = len(jax.devices())
+    prev = os.environ.get("DISQ_TPU_DEVICE_SERVICE")
+    os.environ["DISQ_TPU_DEVICE_SERVICE"] = "1"
+    try:
+        for n_dev in (1, 2, 4, 8):
+            if n_dev > n_avail:
+                break
+            st = ReadsStorage.make_default().resident_decode()
+            if n_dev > 1:
+                st = st.mesh(n_dev)
+
+            def run(st=st):
+                ds = st.read(path)
+                stats = ds.flagstat()
+                assert stats["total"] == N_RECORDS
+                srt = ds.coordinate_sorted()
+                assert srt.count() == N_RECORDS
+
+            run()  # warm (mesh build, compiles, page cache)
+            # per-device lane fill resets per width so each row sees
+            # only its own launches; service restarts per width so its
+            # device snapshot tracks the mesh just built
+            device_service.shutdown_service()
+            REGISTRY.gauge("device.lane_fill")._reset()
+            b0 = (exch.total(), resh.total())
+            med, times = _timed(run, 3)
+            fill = REGISTRY.gauge("device.lane_fill")
+            lane_fill = {
+                lbl: round(st_["mean"], 3)
+                for lbl, st_ in fill._snapshot().items()}
+            rows[f"devices_{n_dev}"] = {
+                "mb_per_sec": round(total_bytes / med / 1e6, 2),
+                "records_per_sec": round(N_RECORDS / med, 1),
+                "spread": _spread(times),
+                "exchange_bytes": int((exch.total() - b0[0]) / len(times)),
+                "reshard_bytes": int((resh.total() - b0[1]) / len(times)),
+                "lane_fill": lane_fill or None,
+            }
+            if n_dev > 1 and "devices_1" in rows:
+                rows[f"speedup_{n_dev}x"] = round(
+                    rows[f"devices_{n_dev}"]["records_per_sec"]
+                    / rows["devices_1"]["records_per_sec"], 3)
+    finally:
+        if prev is None:
+            os.environ.pop("DISQ_TPU_DEVICE_SERVICE", None)
+        else:
+            os.environ["DISQ_TPU_DEVICE_SERVICE"] = prev
+        device_service.shutdown_service()
+    rows["meshes_built"] = sorted(_MESH_CACHE)
+    return {"14_mesh_pipeline": rows}
+
+
 _SCHED_WORKER = r"""
 import json, os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1201,6 +1281,7 @@ def main() -> None:
     configs.update(resident_decode_config(path))
     configs.update(device_write_config(path, tmp))
     configs.update(serve_latency_config(path, tmp))
+    configs.update(mesh_pipeline_config(path))
 
     # Telemetry snapshot accumulated across every config above
     # (runtime/tracing.py): phase totals + p50/p99, labeled counters

@@ -560,6 +560,19 @@ class ReadsStorage:
         self._options = self._options.with_device_deflate(enable)
         return self
 
+    def mesh(self, devices: int = 0) -> "ReadsStorage":
+        """Arm the mesh-native pipeline (``runtime/mesh.py``): resident
+        parse batches shard over a ``batch`` device axis with
+        ``NamedSharding``, the coordinate sort runs as the multi-chip
+        psum-histogram radix sort, and flagstat/depth reduce with
+        ``lax.psum`` — one sharded program across all chips instead of
+        N single-device lanes.  ``devices=0`` uses all local devices,
+        ``n`` the first n (power-of-two floor).  A host resolved to one
+        device keeps the identical single-device dispatch.  Env
+        equivalent: ``DISQ_TPU_MESH``."""
+        self._options = self._options.with_mesh(devices)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -762,6 +775,13 @@ class VariantsStorage:
         deflate of this storage's sinks (VCF_BGZ parts and headers,
         BCF's whole-stream blocks) through the device SIMD encoder."""
         self._options = self._options.with_device_deflate(enable)
+        return self
+
+    def mesh(self, devices: int = 0) -> "VariantsStorage":
+        """See ``ReadsStorage.mesh``.  Today only the BAM resident
+        chain shards over the batch axis; the knob is accepted here so
+        option sets stay interchangeable across storages."""
+        self._options = self._options.with_mesh(devices)
         return self
 
     def num_shards(self, n: int) -> "VariantsStorage":

@@ -630,9 +630,17 @@ class BamSource:
                 words = (dev_handle.assemble()
                          if dev_handle is not None else None)
                 dev_handle = None
+                # mesh-native build (runtime/mesh.py): with the knob
+                # armed the parse shards over the batch axis and the
+                # batch carries its mesh so sort/flagstat/depth stay
+                # one sharded program; mesh_for_storage is two
+                # attribute reads when off
+                from disq_tpu.runtime.mesh import mesh_for_storage
+
                 batch = ColumnarBatch.from_blob(
                     record_bytes, offsets, n_ref=header.n_ref,
-                    device_words=words, origin=lo_u)
+                    device_words=words, origin=lo_u,
+                    mesh=mesh_for_storage(self._storage))
             else:
                 batch = decode_records(
                     record_bytes, offsets, n_ref=header.n_ref)

@@ -94,6 +94,66 @@ def flagstat_resident(flag_dev, n: int) -> Dict[str, int]:
     return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _flagstat_sharded_compiled(mesh, axis: str, per: int):
+    """shard_map'd masked flagstat over a BATCH-SHARDED resident flag
+    column: each device counts its local slice (validity derived from
+    its axis index — global index < n), then one 12-lane ``psum`` over
+    ICI merges the rows. The column never moves; only the 48-byte
+    count row crosses d2h."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    def body(f, n):
+        i = lax.axis_index(axis)
+        base = (i * per).astype(jnp.int32)
+        valid = ((base + jnp.arange(per, dtype=jnp.int32)) <
+                 n).astype(jnp.int32)
+        return lax.psum(_counts(f.astype(jnp.int32), valid), axis)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P()))
+
+
+def flagstat_resident_sharded(
+    flag_dev, n: int, mesh, axis: Optional[str] = None
+) -> Dict[str, int]:
+    """``flagstat_resident`` for a mesh-sharded resident flag column
+    (tentpole c): same zero-h2d contract, reduction via ``lax.psum``
+    over the batch axis instead of a single-device pass.  Exact —
+    integer adds reassociate freely, so the row equals the host
+    truth bit-for-bit."""
+    from disq_tpu.runtime.mesh import MESH_AXIS, shard_count
+
+    if axis is None:
+        axis = MESH_AXIS if MESH_AXIS in mesh.axis_names \
+            else mesh.axis_names[0]
+    n_dev = int(shard_count(mesh) if axis == MESH_AXIS
+                else mesh.shape[axis])
+    per = int(flag_dev.shape[0]) // n_dev
+    from disq_tpu.runtime.tracing import count_transfer, device_span
+
+    # staged pre-guard with its mesh placement (4 bytes, replicated) —
+    # an implicit reshard inside the guard would raise
+    n_arr = jax.device_put(
+        jnp.asarray(np.int32(n)), NamedSharding(mesh, P()))
+    with device_span("device.kernel", kernel="flagstat",
+                     records=int(n), devices=n_dev) as fence:
+        with jax.transfer_guard("disallow"):
+            out = _flagstat_sharded_compiled(mesh, axis, per)(
+                flag_dev, n_arr)
+            jax.block_until_ready(out)
+        fence.sync(out)
+    row = np.asarray(out)
+    count_transfer("d2h", row.nbytes)
+    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, row)}
+
+
 def flagstat_counts(
     flag: np.ndarray, mesh: Optional[Mesh] = None, axis: str = "shards"
 ) -> Dict[str, int]:

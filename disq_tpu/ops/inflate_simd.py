@@ -962,12 +962,18 @@ _CONST_CACHE: Dict[Any, tuple] = {}
 _CONST_LOCK = threading.Lock()
 
 
-def _device_const_tables() -> tuple:
+def _device_const_tables(dev=None) -> tuple:
     """The kernel's constant (R,128) tables as device-resident arrays,
     uploaded ONCE per device per process.  Previously every
     ``inflate_payloads_simd`` call re-ran ``jnp.asarray`` over all
-    seven tables — a fresh ~200 KB H2D upload per shard."""
-    dev = jax.devices()[0]
+    seven tables — a fresh ~200 KB H2D upload per shard.
+
+    ``dev=None`` resolves to the ambient default device, so a service
+    engine running under ``jax.default_device(d)`` (the per-device
+    dispatcher lanes, runtime/device_service.py) gets tables resident
+    on ITS chip — the cache is device-keyed either way."""
+    if dev is None:
+        dev = jax.config.jax_default_device or jax.devices()[0]
     with _CONST_LOCK:
         cached = _CONST_CACHE.get(dev)
         if cached is None:

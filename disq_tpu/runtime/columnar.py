@@ -167,6 +167,12 @@ class ColumnarBatch:
         # ragged/interop apply it lazily
         self._order: Optional[np.ndarray] = None
         self._n_ref: Optional[int] = None
+        # batch-axis device mesh (runtime/mesh.py) the resident columns
+        # are sharded over; None = plain single-device residency.
+        # Carried through permuted()/concat() so every downstream
+        # consumer (sort, flagstat, depth, encode) sees one sharded
+        # program instead of re-deriving placement per stage.
+        self._mesh = None
         self._cache: Dict[str, np.ndarray] = {}
         self._consumed: Dict[str, int] = {}
         self._ragged_rb: Optional[ReadBatch] = None
@@ -199,6 +205,7 @@ class ColumnarBatch:
         device_words=None,
         origin: int = 0,
         interpret: Optional[bool] = None,
+        mesh=None,
     ) -> "ColumnarBatch":
         """Fused device build: one upload (skipped when
         ``device_words`` carries the inflate kernels' still-resident
@@ -223,6 +230,7 @@ class ColumnarBatch:
         self._blob = blob
         self._offsets = np.asarray(offsets, dtype=np.int64)
         self._n_ref = n_ref
+        self._mesh = mesh
         with span("columnar.batch.build", records=n,
                   bytes=int(offsets[-1])):
             # origin rebases offsets into a full-shard device blob;
@@ -231,7 +239,7 @@ class ColumnarBatch:
             cols, _word_bytes, _ = parse_columns_resident(
                 blob, self._offsets, words_dev=device_words,
                 origin=origin if device_words is not None else 0,
-                interpret=interpret)
+                interpret=interpret, mesh=mesh)
             # keep only the 8 reachable fixed columns resident (plus
             # next_refid for validation below); the 4 parse-only
             # length fields are derivable from the ragged offsets and
@@ -282,6 +290,12 @@ class ColumnarBatch:
     @property
     def device_backed(self) -> bool:
         return self._dev is not None
+
+    @property
+    def mesh(self):
+        """The batch-axis mesh the resident columns shard over, or
+        None (single-device residency / host-backed)."""
+        return self._mesh if self._dev is not None else None
 
     @property
     def count(self) -> int:
@@ -477,9 +491,15 @@ class ColumnarBatch:
             from disq_tpu.ops.flagstat import flagstat_counts
 
             return flagstat_counts(np.asarray(self.flag))
-        from disq_tpu.ops.flagstat import flagstat_resident
+        if self._mesh is not None:
+            from disq_tpu.ops.flagstat import flagstat_resident_sharded
 
-        out = flagstat_resident(dev["flag"], self._n)
+            out = flagstat_resident_sharded(
+                dev["flag"], self._n, self._mesh)
+        else:
+            from disq_tpu.ops.flagstat import flagstat_resident
+
+            out = flagstat_resident(dev["flag"], self._n)
         self._consume_on_device("flag", 4 * self._n)
         return out
 
@@ -493,6 +513,13 @@ class ColumnarBatch:
 
             return np.argsort(
                 coordinate_keys(self.refid, self.pos), kind="stable")
+        if self._mesh is not None:
+            from disq_tpu.sort.sharded import resident_coordinate_sort
+
+            out = resident_coordinate_sort(
+                dev["refid"], dev["pos"], self._n, self._mesh)
+            self._consume_on_device("sort_keys", 8 * self._n)
+            return out
         fns = _jax_fns()
         jax, jnp = fns["jax"], fns["jnp"]
         from disq_tpu.runtime.tracing import count_transfer, device_span
@@ -547,6 +574,17 @@ class ColumnarBatch:
         out._n = self._n
         out._n_ref = self._n_ref
         out._dev = {name: dev[name][idx] for name in FIXED_COLUMNS}
+        if self._mesh is not None:
+            # the gather may have collapsed placement — restore the
+            # canonical batch sharding so downstream stages keep the
+            # one-sharded-program shape (moved bytes are booked into
+            # device.mesh.reshard_bytes, not h2d/d2h: nothing crosses
+            # the host)
+            from disq_tpu.runtime.mesh import mesh_put
+
+            out._dev = {name: mesh_put(col, self._mesh)
+                        for name, col in out._dev.items()}
+            out._mesh = self._mesh
         out._blob = self._blob
         out._blob_parts = self._blob_parts
         out._offsets = self._offsets
@@ -611,6 +649,17 @@ class ColumnarBatch:
                     (0, pad), mode="edge")
                 for name in FIXED_COLUMNS
             }
+            # mesh carriage: a concat of same-mesh shards stays one
+            # sharded program (the slice/concat/pad above may have
+            # collapsed placement — normalize back to batch sharding)
+            mesh = batches[0]._mesh
+            if mesh is not None and all(
+                    b._mesh is mesh for b in batches):
+                from disq_tpu.runtime.mesh import mesh_put
+
+                self._dev = {name: mesh_put(col, mesh)
+                             for name, col in self._dev.items()}
+                self._mesh = mesh
             # host blobs join LAZILY (first ragged access / pickle):
             # a flagstat-only multi-shard read never pays the
             # O(total-decoded-bytes) memcpy or its transient 2x host

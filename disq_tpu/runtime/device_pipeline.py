@@ -118,6 +118,30 @@ def _parse_columns(blob_words: jax.Array, starts: jax.Array,
     return parse_fixed_words_pallas(words, interpret=interpret)
 
 
+@functools.lru_cache(maxsize=8)
+def _mesh_parse_compiled(mesh, interpret: bool):
+    """shard_map'd gather+parse over the batch mesh axis: the word
+    blob is replicated (every record's prefix may straddle any byte),
+    the bucket-padded starts shard over ``batch``, and each device
+    runs the SAME local gather + Pallas parse the single-device jit
+    runs — out columns come back 1-D and batch-sharded, exactly the
+    ``ColumnarBatch`` column shape."""
+    from disq_tpu.runtime.mesh import MESH_AXIS
+    from disq_tpu.ops.parse import parse_fixed_words_pallas
+    from disq_tpu.sort.sharded import _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(blob_words, starts):
+        words = gather_record_words(blob_words, starts)
+        return parse_fixed_words_pallas(words, interpret=interpret)
+
+    # check_rep=False: shard_map has no replication rule for
+    # pallas_call; the body is per-device-local by construction
+    return jax.jit(_shard_map()(
+        body, mesh=mesh, in_specs=(P(None), P(MESH_AXIS)),
+        out_specs=P(MESH_AXIS), check_rep=False))
+
+
 def upload_blob_words(blob: np.ndarray) -> Tuple[jax.Array, int]:
     """Word-align a decoded byte blob with ONE preallocated buffer +
     tail write and upload it; returns (device u32 words, bytes moved).
@@ -254,6 +278,7 @@ def parse_columns_resident(
     words_dev: Optional[jax.Array] = None,
     origin: int = 0,
     interpret: bool = False,
+    mesh=None,
 ) -> Tuple[Dict[str, jax.Array], int, int]:
     """One fused upload(+)gather(+)parse launch chain producing the raw
     device column dict (bucket-padded; callers slice to ``n``).
@@ -261,8 +286,16 @@ def parse_columns_resident(
     ``words_dev`` (from ``assemble_device_words``) skips the blob
     upload entirely — the parse reads the inflate kernel's output where
     it already lives in HBM; ``origin`` rebases record offsets into
-    that blob. Returns (cols, resident word bytes, record count)."""
-    from disq_tpu.runtime.tracing import count_transfer, device_span, span
+    that blob. Returns (cols, resident word bytes, record count).
+
+    With ``mesh`` (runtime/mesh.py batch-axis mesh) the parse runs as
+    ONE sharded program: the word blob replicates to every device (h2d
+    and HBM booked per copy — accounting stays per-device-correct),
+    the bucket-padded starts shard over ``batch`` (power-of-two bucket
+    sizes always divide the power-of-two axis), and the returned
+    columns are batch-sharded device arrays."""
+    from disq_tpu.runtime.tracing import (
+        count_transfer, counter, device_span, span)
 
     n = len(offsets) - 1
     if int(offsets[-1]) + origin >= 2 ** 31:
@@ -270,6 +303,12 @@ def parse_columns_resident(
             f"decoded shard is {int(offsets[-1]) + origin} bytes; the "
             "device pipeline indexes with i32 — split the shard below "
             "2 GiB")
+    n_dev = 1
+    if mesh is not None:
+        from disq_tpu.runtime.mesh import (
+            batch_sharding, mesh_put, replicated, shard_count)
+
+        n_dev = shard_count(mesh)
     starts_host = pad_starts(offsets, origin)
     if words_dev is None:
         # quantum-pad the blob like the starts: shard blob sizes vary
@@ -281,23 +320,47 @@ def parse_columns_resident(
         padded[: len(blob)] = blob
         padded[len(blob):] = 0
         with span("device.transfer", direction="h2d"):
-            words_dev = jax.device_put(
-                jnp.asarray(padded.view("<u4")))
-            starts_dev = jax.device_put(jnp.asarray(starts_host))
-        count_transfer("h2d", padded.nbytes + starts_host.nbytes)
-        word_bytes = padded.nbytes
+            if mesh is None:
+                words_dev = jax.device_put(jnp.asarray(padded.view("<u4")))
+                starts_dev = jax.device_put(jnp.asarray(starts_host))
+            else:
+                words_dev = jax.device_put(
+                    jnp.asarray(padded.view("<u4")), replicated(mesh))
+                starts_dev = jax.device_put(
+                    jnp.asarray(starts_host), batch_sharding(mesh))
+        # the replicated blob lands on every device: book each copy
+        count_transfer("h2d", padded.nbytes * n_dev + starts_host.nbytes)
+        word_bytes = padded.nbytes * n_dev
     else:
         with span("device.transfer", direction="h2d"):
-            starts_dev = jax.device_put(jnp.asarray(starts_host))
+            if mesh is None:
+                starts_dev = jax.device_put(jnp.asarray(starts_host))
+            else:
+                # the inflate chain left the blob on one device —
+                # replicate it over ICI (mesh_put books the fan-out
+                # into device.mesh.reshard_bytes, not h2d: it never
+                # crosses the host)
+                words_dev = mesh_put(words_dev, mesh, batch=False)
+                starts_dev = jax.device_put(
+                    jnp.asarray(starts_host), batch_sharding(mesh))
         count_transfer("h2d", starts_host.nbytes)
-        word_bytes = int(words_dev.size) * 4
+        word_bytes = int(words_dev.size) * 4 * n_dev
+    # bind the compiled fn OUTSIDE the guard: its first construction
+    # imports sort/sharded, whose module constants are device puts
+    parse_fn = (_parse_columns if mesh is None
+                else _mesh_parse_compiled(mesh, interpret))
     with device_span("device.kernel", kernel="columnar_parse",
-                     records=n) as fence:
+                     records=n, devices=n_dev) as fence:
         with jax.transfer_guard("disallow"):
-            cols = _parse_columns(words_dev, starts_dev,
-                                  interpret=interpret)
+            if mesh is None:
+                cols = parse_fn(words_dev, starts_dev,
+                                interpret=interpret)
+            else:
+                cols = parse_fn(words_dev, starts_dev)
             jax.block_until_ready(cols["pos"])
         fence.sync(cols["pos"])
+    if mesh is not None:
+        counter("device.mesh.batches").inc()
     return cols, word_bytes + starts_host.nbytes, n
 
 

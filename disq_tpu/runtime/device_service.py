@@ -25,6 +25,17 @@ and writes each decoded lane straight from the kernel's transposed
 output into the owning submission's preallocated blob — zero
 intermediate ``bytes`` objects on the device path.
 
+Multi-chip (the mesh-native pipeline, ``runtime/mesh.py``): when the
+mesh knob is armed at service creation, each codec keeps one sub-queue
+PER DEVICE and the single dispatcher feeds them all — a submission's
+lanes land on the least-loaded device, each launch runs under
+``jax.default_device(dev)`` (const tables and staging land on that
+chip, ``inflate_simd._device_const_tables`` is device-keyed), and the
+in-flight window scales by the device count so every chip keeps a full
+pipeline instead of device 0 taking all launches.  Mesh off, the
+device list is ``[None]`` and every code path below degenerates to the
+exact single-queue behavior it had before.
+
 Error isolation is strict per submission: a lane the kernel flags is
 re-inflated on host; if the host also fails (truly corrupt input) only
 the OWNER shard's future raises — lanes co-batched from other shards
@@ -348,15 +359,25 @@ class DeviceDecodeService:
             "deflate": _DeflateEngine(interpret, self._host_map),
         }
         self._cond = threading.Condition()
-        self._queues: Dict[str, Deque[_Lane]] = {
-            "inflate": deque(), "rans": deque(), "deflate": deque()}
+        # dispatch targets, snapshotted once: [None] (default-device
+        # semantics) unless the mesh knob was armed before service
+        # start — then one sub-queue per mesh device (module docstring)
+        from disq_tpu.runtime.mesh import service_devices
+
+        self._devices = service_devices()
+        n_dev = len(self._devices)
+        self._queues: Dict[str, List[Deque[_Lane]]] = {
+            k: [deque() for _ in range(n_dev)]
+            for k in ("inflate", "rans", "deflate")}
         self._inflight: Deque[Tuple[str, Any, List[_Lane]]] = deque()
         self._closed = False
         # window sized for the standard full-BGZF geometry; the env
-        # knobs in dispatch_window apply here too
+        # knobs in dispatch_window apply here too.  Scaled by the
+        # device count: the window bounds launches IN FLIGHT, and with
+        # n chips each wants its own pipeline of them
         from disq_tpu.ops.inflate_simd import dispatch_window
 
-        self._window = dispatch_window(4, 16 << 20)
+        self._window = dispatch_window(4, 16 << 20) * n_dev
         self._thread = threading.Thread(
             target=self._run, name="disq-device-dispatch", daemon=True)
         self._thread.start()
@@ -463,8 +484,15 @@ class DeviceDecodeService:
         with self._cond:
             if self._closed:
                 raise RuntimeError("device decode service is closed")
-            self._queues[kind].extend(lanes)
-            depth = sum(len(q) for q in self._queues.values())
+            # least-loaded device sub-queue takes the whole batch (one
+            # submission's lanes stay together — they share pack
+            # geometry and error scope); with one device this is the
+            # old single-queue append
+            subqs = self._queues[kind]
+            subqs[min(range(len(subqs)),
+                      key=lambda i: len(subqs[i]))].extend(lanes)
+            depth = sum(
+                len(q) for qs in self._queues.values() for q in qs)
             if sub._pending <= 0:
                 sub._event.set()
             self._cond.notify_all()
@@ -540,8 +568,8 @@ class DeviceDecodeService:
                         return
                     self._cond.wait(self._wait_s_locked())
             if chunk is not None:
-                kind, lanes, reason = chunk
-                entry = self._launch(kind, lanes, reason)
+                kind, dev_i, lanes, reason = chunk
+                entry = self._launch(kind, dev_i, lanes, reason)
                 if entry is not None:
                     self._inflight.append(entry)
             if self._inflight and (chunk is None
@@ -550,13 +578,15 @@ class DeviceDecodeService:
 
     def _take_chunk_locked(self):
         now = time.perf_counter()
-        # oldest-lane-first across kinds: a sustained full-chunk burst
-        # on one codec must not starve the other queue's lanes past
-        # their flush deadline
-        for kind in sorted(
-                (k for k, q in self._queues.items() if q),
-                key=lambda k: self._queues[k][0].ts):
-            q = self._queues[kind]
+        # oldest-lane-first across (kind, device) sub-queues: a
+        # sustained full-chunk burst on one codec or chip must not
+        # starve another queue's lanes past their flush deadline
+        ready = sorted(
+            ((k, i) for k, qs in self._queues.items()
+             for i, q in enumerate(qs) if q),
+            key=lambda ki: self._queues[ki[0]][ki[1]][0].ts)
+        for kind, i in ready:
+            q = self._queues[kind][i]
             if len(q) >= LANES:
                 lanes = [q.popleft() for _ in range(LANES)]
                 reason = "full"
@@ -566,32 +596,47 @@ class DeviceDecodeService:
                 reason = "drain" if self._closed else "timeout"
             else:
                 continue
-            return kind, lanes, reason
+            return kind, i, lanes, reason
         return None
 
     def _wait_s_locked(self) -> Optional[float]:
         now = time.perf_counter()
         waits = [
             self.flush_timeout_s - (now - q[0].ts)
-            for q in self._queues.values() if q
+            for qs in self._queues.values() for q in qs if q
         ]
         if not waits:
             return None  # nothing queued: sleep until a notify
         return max(1e-3, min(waits))
 
-    def _launch(self, kind: str, lanes: List[_Lane], reason: str):
+    def _launch(self, kind: str, dev_i: int, lanes: List[_Lane],
+                reason: str):
+        dev = self._devices[dev_i]
         _counter("device.batch.flush").inc(reason=reason)
         _flightrec.record_event("device_flush", codec=kind,
                                 reason=reason, lanes=len(lanes))
-        _observe_gauge("device.lane_fill", len(lanes) / LANES)
+        # mesh-off ([None]) keeps the historic unlabeled gauge; a real
+        # device list labels fill per chip so partial lanes on one
+        # device are visible, not averaged away
+        if dev is None:
+            _observe_gauge("device.lane_fill", len(lanes) / LANES)
+        else:
+            _observe_gauge("device.lane_fill", len(lanes) / LANES,
+                           device=str(dev_i))
         _observe_gauge(
             "device.queue_depth",
-            sum(len(q) for q in self._queues.values()))
+            sum(len(q) for qs in self._queues.values() for q in qs))
         _record_span("device.service.wait",
                      time.perf_counter() - min(l.ts for l in lanes),
                      kind=kind, lanes=len(lanes))
         try:
-            handle = self._engines[kind].launch(lanes)
+            if dev is None:
+                handle = self._engines[kind].launch(lanes)
+            else:
+                import jax
+
+                with jax.default_device(dev):
+                    handle = self._engines[kind].launch(lanes)
         except BaseException as e:  # noqa: BLE001 — owners, not the loop
             for lane in lanes:
                 lane.sub.fail(e)
@@ -609,9 +654,11 @@ class DeviceDecodeService:
     def _abort_all(self, exc: BaseException) -> None:
         with self._cond:
             self._closed = True
-            pending = [l for q in self._queues.values() for l in q]
-            for q in self._queues.values():
-                q.clear()
+            pending = [
+                l for qs in self._queues.values() for q in qs for l in q]
+            for qs in self._queues.values():
+                for q in qs:
+                    q.clear()
             inflight = list(self._inflight)
             self._inflight.clear()
         for _kind, _handle, lanes in inflight:

@@ -199,6 +199,14 @@ class DisqOptions:
     # ⇒ canonical host zlib and zero device allocations
     # (check_overhead-guarded).
     device_deflate: bool = False
+    # Mesh-native device pipeline (runtime/mesh.py): None (default)
+    # keeps every device stage on the single-device dispatch and
+    # builds no Mesh object (check_overhead-guarded); 0 shards the
+    # resident parse/sort/reduce chain over ALL local devices on a
+    # batch axis; n >= 1 uses the first n devices (rounded down to a
+    # power of two; 1 ⇒ the off path). Env equivalent: DISQ_TPU_MESH
+    # (unset/0/off ⇒ off, all/auto ⇒ all devices, integer ⇒ first n).
+    mesh: Optional[int] = None
     # Cross-host shard scheduler (runtime/scheduler.py): None (default)
     # keeps the static split loops with zero coordinator threads or
     # sockets; "serve" hosts the coordinator on this process's
@@ -339,6 +347,14 @@ class DisqOptions:
 
     def with_device_deflate(self, enable: bool = True) -> "DisqOptions":
         return replace(self, device_deflate=bool(enable))
+
+    def with_mesh(self, devices: int = 0) -> "DisqOptions":
+        """Arm the mesh-native pipeline: 0 = all local devices, n = the
+        first n (power-of-two floor; resolving to 1 device keeps the
+        plain single-device dispatch)."""
+        if devices < 0:
+            raise ValueError(f"mesh devices must be >= 0, got {devices}")
+        return replace(self, mesh=int(devices))
 
 
 class CorruptBlockError(ValueError):

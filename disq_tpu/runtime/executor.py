@@ -65,7 +65,8 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from typing import (
+    Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple)
 
 from disq_tpu.runtime import flightrec
 from disq_tpu.runtime.errors import (
@@ -711,6 +712,13 @@ class WriteShardTask:
     stage: Optional[Callable[[Any], Any]] = None
     retrier: Optional[ShardRetrier] = None
     what: str = "write"
+    # estimated output byte range of this shard's part within the
+    # merged file (uncompressed record bytes) — the write-lease
+    # locality hint: scheduled_write_stage registers it with the
+    # coordinator so write leases score contiguity/cache locality the
+    # way read leases do, instead of FIFO-only.  None (default) keeps
+    # the pure-FIFO write lease.
+    byte_range: Optional[Tuple[int, int]] = None
 
 
 @dataclass
@@ -1001,6 +1009,7 @@ def run_write_stage(
     retries: int = 1,
     storage=None,
     path: Optional[str] = None,
+    fs=None,
 ) -> List[Any]:
     """Run one write stage's shards through ``pipeline``, shard-level
     resumable. With a manifest, shards already recorded are skipped,
@@ -1016,8 +1025,9 @@ def run_write_stage(
     armed, the stage instead leases its shards through the coordinator
     (``scheduler.scheduled_write_stage`` — the write direction of the
     distributed data plane, with the manifest as the durable side);
-    otherwise this inline path runs unchanged, allocating nothing
-    extra."""
+    ``fs`` (the destination filesystem) feeds the worker's block-cache
+    locality hint into those leases.  Otherwise this inline path runs
+    unchanged, allocating nothing extra."""
     from dataclasses import replace
 
     if manifest is not None and storage is not None and path is not None:
@@ -1026,7 +1036,7 @@ def run_write_stage(
         if scheduler.write_leasing_armed(storage):
             return scheduler.scheduled_write_stage(
                 storage, path, pipeline, n_shards, make_task, manifest,
-                stage_name=stage_name, retries=retries)
+                stage_name=stage_name, retries=retries, fs=fs)
 
     infos: List[Any] = [None] * n_shards
     pending: List[int] = []

@@ -1408,7 +1408,7 @@ def write_leasing_armed(storage) -> bool:
 def scheduled_write_stage(storage, path: str, pipeline, n_shards: int,
                           make_task, manifest,
                           stage_name: str = "write.parts",
-                          retries: int = 1) -> List[Any]:
+                          retries: int = 1, fs=None) -> List[Any]:
     """``run_write_stage`` behind the shard scheduler: the write
     stage's shards lease through the same coordinator as reads (run
     key suffixed ``#write``, lease docs carry ``dir=write``) with the
@@ -1423,7 +1423,15 @@ def scheduled_write_stage(storage, path: str, pipeline, n_shards: int,
     is disabled in the write direction: a stolen write would stage the
     same part twice concurrently; crash recovery goes through lease
     expiry alone.  Returns the per-shard info list in shard order,
-    assembling other hosts' infos from the shared manifest."""
+    assembling other hosts' infos from the shared manifest.
+
+    Locality: tasks carrying a ``byte_range`` (the sink's estimated
+    output byte range per part) register it in the run doc, and each
+    lease ships the worker's block-cache occupancy for ``path``
+    (``fs`` permitting) — write leases then route through the exact
+    locality scoring read leases use, so contiguous parts land on the
+    host already holding neighboring output blocks instead of pure
+    FIFO.  Range-less tasks keep the FIFO behavior."""
     from dataclasses import replace
 
     from disq_tpu.runtime.executor import _retrying, run_write_stage
@@ -1436,10 +1444,18 @@ def scheduled_write_stage(storage, path: str, pipeline, n_shards: int,
     # several processes mark into one manifest file: merge-on-flush,
     # and batch the rewrite+fsync behind a small interval
     manifest.mark_shared(flush_interval_s=0.05)
+    # one task build per shard (the closures are cheap): the byte
+    # ranges go into the run doc now, the same objects serve the lease
+    # loop below
+    raw_tasks = {k: make_task(k) for k in range(n_shards)}
     client.join({
         "key": run_key_for(path, n_shards, direction="write"),
         "path": path,
-        "shards": {str(k): None for k in range(n_shards)},
+        "shards": {
+            str(k): (list(t.byte_range)
+                     if getattr(t, "byte_range", None) else None)
+            for k, t in raw_tasks.items()
+        },
         "dir": "write",
     })
     # resume: report manifest-recorded shards done so they never lease
@@ -1448,7 +1464,7 @@ def scheduled_write_stage(storage, path: str, pipeline, n_shards: int,
             client.done(k)
 
     def task_for(k: int):
-        task = make_task(k)
+        task = raw_tasks[k]
         inner = _retrying(task.stage, retries)
 
         def marked(payload, _inner=inner, _k=k):
@@ -1462,7 +1478,8 @@ def scheduled_write_stage(storage, path: str, pipeline, n_shards: int,
 
     idle = _IDLE_SLEEP_MIN_S
     while True:
-        resp = client.lease()
+        resp = client.lease(_cache_hints(fs, path)
+                            if fs is not None else None)
         if resp.get("error"):
             raise IOError(
                 f"scheduler write lease failed: {resp['error']}")

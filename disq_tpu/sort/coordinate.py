@@ -57,7 +57,12 @@ def coordinate_sort_batch(batch: ReadBatch, use_mesh: bool = True,
     if isinstance(batch, ColumnarBatch):
         if batch.device_backed and batch.count > 0:
             # resident sort-key extraction: byte-identical to the host
-            # argsort (same key, both stable), zero key traffic
+            # argsort (same key, both stable), zero key traffic.  A
+            # mesh-sharded batch routes through the multi-chip
+            # psum-histogram exchange (sharded.resident_coordinate_sort)
+            # with the same byte-identity contract — rows ride as the
+            # least-significant lexsort component, so duplicate keys
+            # keep original-index order at any device count.
             order = batch.sort_permutation()
             if keep_resident and batch.encode_source() is not None:
                 return batch.permuted(order)

@@ -723,3 +723,186 @@ def hierarchical_coordinate_sort(
 
     return _keys_exchange_host_wrapper(
         keys_np, n_shards, put, run, capacity_factor, max_retries)
+
+
+# ---------------------------------------------------------------------------
+# Resident multi-chip sort (ROADMAP item 3 tentpole b): the coordinate
+# sort consumed straight from a mesh-sharded ColumnarBatch — keys never
+# exist on the host; splitters come from per-device key histograms
+# exchanged via lax.psum (the SNIPPETS north-star "psum histogram
+# exchange") instead of a host sample.
+
+
+@functools.lru_cache(maxsize=16)
+def _resident_keys_compiled(mesh: Mesh, axis: str, n_shards: int):
+    """Key build over batch-sharded refid/pos columns: same formula as
+    the single-device ``coord_perm`` (unmapped → 0x7FFFFFFF, bucket
+    padding → full-sentinel pairs) plus global row ids, reshaped to the
+    (n_shards, per) exchange layout with zero resharding."""
+    def build(refid, pos, n):
+        m = refid.shape[0]
+        valid = jnp.arange(m, dtype=jnp.int32) < n
+        rid = jnp.where(refid < 0, jnp.uint32(0x7FFFFFFF),
+                        refid.astype(jnp.uint32))
+        hi = jnp.where(valid, rid, SENT32)
+        lo = jnp.where(valid, (pos + 1).astype(jnp.uint32), SENT32)
+        rows = jnp.arange(m, dtype=jnp.uint32)
+        shp = (n_shards, m // n_shards)
+        return hi.reshape(shp), lo.reshape(shp), rows.reshape(shp)
+
+    out_sh = NamedSharding(mesh, P(axis, None))
+    return jax.jit(build, out_shardings=(out_sh, out_sh, out_sh))
+
+
+def _key_byte(hi, lo, level: int):
+    """Byte ``level`` (7 = most significant) of the (hi, lo) u64 key."""
+    if level >= 4:
+        return (hi >> jnp.uint32(8 * (level - 4))) & jnp.uint32(0xFF)
+    return (lo >> jnp.uint32(8 * level)) & jnp.uint32(0xFF)
+
+
+@functools.lru_cache(maxsize=64)
+def _hist_level_compiled(mesh: Mesh, axis: str, n_cuts: int, level: int):
+    """One refinement level of the psum-histogram splitter search:
+    every device bins byte ``level`` of its LOCAL keys restricted to
+    each cut's already-resolved prefix (levels above ``level``), then
+    one ``lax.psum`` over the mesh axis makes the (n_cuts, 256)
+    histogram global. Only that small table crosses d2h per level —
+    the keys themselves never move."""
+    def body(hi, lo, pref):
+        hi, lo = hi.reshape(-1), lo.reshape(-1)
+        valid = ~((hi == SENT32) & (lo == SENT32))
+        tgt = _key_byte(hi, lo, level).astype(jnp.int32)
+        rows = []
+        for c in range(n_cuts):
+            mask = valid
+            for up in range(level + 1, 8):
+                mask = mask & (
+                    _key_byte(hi, lo, up).astype(jnp.int32) == pref[c, up])
+            rows.append(jnp.bincount(
+                jnp.where(mask, tgt, 256), length=257)[:256])
+        hist = jnp.stack(rows).astype(jnp.int32)
+        return lax.psum(hist, axis)
+
+    return jax.jit(_shard_map()(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(None, None)),
+        out_specs=P(None, None)))
+
+
+def _psum_splitters(hi2, lo2, n: int, mesh: Mesh, axis: str,
+                    n_shards: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact quantile splitters for the range partition, computed by
+    MSB→LSB byte refinement over psum'd per-device histograms: level 7
+    bins the top byte of every key; each cut picks the bin its target
+    rank falls in, subtracts the mass below it, and descends — after 8
+    levels the accumulated bytes ARE the key value at that rank.
+    Monotone by construction (prefix order = key order), so the range
+    partition stays valid; returns (s_hi, s_lo) u32 pairs."""
+    from disq_tpu.runtime.tracing import count_transfer, counter
+
+    n_cuts = n_shards - 1
+    if n_cuts <= 0 or n == 0:
+        z = np.zeros(max(n_cuts, 0), dtype=np.uint32)
+        return z, z.copy()
+    # 0-indexed target ranks among the n valid keys (value-at-quantile,
+    # like sample_splitters' sample[qs])
+    remaining = np.array(
+        [max(0, ((c + 1) * n) // n_shards - 1) for c in range(n_cuts)],
+        dtype=np.int64)
+    pref = np.full((n_cuts, 8), -1, dtype=np.int32)
+    repl = NamedSharding(mesh, P(None, None))
+    for level in range(7, -1, -1):
+        pref_dev = jax.device_put(jnp.asarray(pref), repl)
+        hist = np.asarray(_hist_level_compiled(
+            mesh, axis, n_cuts, level)(hi2, lo2, pref_dev))
+        # the psum fans each device's (n_cuts, 257) partial over ICI;
+        # the prefix table replicates h2d per device
+        counter("device.mesh.exchange_bytes").inc(
+            (hist.nbytes + 4 * n_cuts) * n_shards)
+        count_transfer("h2d", pref.nbytes)
+        count_transfer("d2h", hist.nbytes)
+        cum = np.cumsum(hist, axis=1)
+        for c in range(n_cuts):
+            v = int(np.searchsorted(cum[c], remaining[c], side="right"))
+            v = min(v, 255)
+            pref[c, level] = v
+            if v > 0:
+                remaining[c] -= int(cum[c, v - 1])
+    key = np.zeros(n_cuts, dtype=np.uint64)
+    for level in range(8):
+        key |= pref[:, level].astype(np.uint64) << np.uint64(8 * level)
+    return split_u64_keys(key)
+
+
+def resident_coordinate_sort(
+    refid_dev, pos_dev, n: int, mesh: Mesh,
+    axis: Optional[str] = None,
+    capacity_factor: float = 2.0, max_retries: int = 3,
+) -> np.ndarray:
+    """Multi-chip coordinate sort of a RESIDENT batch-sharded column
+    pair (tentpole b): key build, psum-histogram splitters, and the
+    all_to_all range exchange all run on the mesh — the only d2h is
+    the per-level histogram table and the final row-id permutation.
+
+    Byte-identity contract: rows ride as the least-significant lexsort
+    component, so duplicate coordinates keep original-index order and
+    the returned permutation equals the host
+    ``np.argsort(keys, kind="stable")`` exactly — sorted BAM + BAI
+    built from it are byte-identical to the single-device output at
+    any device count."""
+    from disq_tpu.runtime.mesh import MESH_AXIS
+    from disq_tpu.runtime.tracing import (
+        count_transfer, counter, device_span)
+
+    if axis is None:
+        axis = MESH_AXIS if MESH_AXIS in mesh.axis_names \
+            else mesh.axis_names[0]
+    n_shards = int(mesh.shape[axis])
+    m = int(refid_dev.shape[0])
+    per_shard = m // n_shards
+    # staged pre-guard with its mesh placement (4 bytes, replicated) —
+    # an implicit reshard inside the guard would raise
+    n_arr = jax.device_put(
+        jnp.asarray(np.int32(n)), NamedSharding(mesh, P()))
+    with device_span("device.kernel", kernel="mesh_sort_keys",
+                     records=n, devices=n_shards) as fence:
+        with jax.transfer_guard("disallow"):
+            hi2, lo2, rows2 = _resident_keys_compiled(
+                mesh, axis, n_shards)(refid_dev, pos_dev, n_arr)
+            jax.block_until_ready(rows2)
+        fence.sync(rows2)
+    s_hi_np, s_lo_np = _psum_splitters(hi2, lo2, n, mesh, axis, n_shards)
+    repl = NamedSharding(mesh, P(None))
+    s_hi = jax.device_put(jnp.asarray(s_hi_np), repl)
+    s_lo = jax.device_put(jnp.asarray(s_lo_np), repl)
+    count_transfer("h2d", s_hi_np.nbytes + s_lo_np.nbytes)
+    cf = capacity_factor
+    for _ in range(max_retries):
+        cap = min(int(per_shard * cf / n_shards) + 1, per_shard)
+        with device_span("device.kernel", kernel="mesh_sort_exchange",
+                         records=n, devices=n_shards) as fence:
+            oh, ol, orows, counts, ok = sharded_sort_step(
+                hi2, lo2, rows2, s_hi, s_lo,
+                mesh=mesh, axis=axis, capacity_factor=cf)
+            fence.sync(counts)
+        # send buffers: 3 u32 arrays of (n_shards, cap) per device
+        counter("device.mesh.exchange_bytes").inc(
+            3 * 4 * cap * n_shards * n_shards)
+        if bool(jnp.all(ok)):
+            cnt = np.asarray(counts).reshape(-1)
+            or_h = np.asarray(orows).reshape(n_shards, -1)
+            count_transfer("d2h", cnt.nbytes + or_h.nbytes)
+            return np.concatenate(
+                [or_h[i, : cnt[i]] for i in range(n_shards)]
+            ).astype(np.int64)
+        cf *= 2.0
+    # pathological skew defeated the capacity retries: fetch the key
+    # columns once and finish on host (counted — this is the documented
+    # fallback, not an implicit copy)
+    hi_h = np.asarray(hi2).reshape(-1)[:n]
+    lo_h = np.asarray(lo2).reshape(-1)[:n]
+    count_transfer("d2h", hi_h.nbytes + lo_h.nbytes)
+    keys = (hi_h.astype(np.uint64) << np.uint64(32)) | \
+        lo_h.astype(np.uint64)
+    return np.argsort(keys, kind="stable")

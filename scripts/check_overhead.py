@@ -217,6 +217,39 @@ def main() -> int:
             "ColumnarBatch builds on the disabled path — resident "
             "decode off must allocate nothing on device")
 
+    # -- 1d. device mesh: off ⇒ no Mesh object, no resharding ----------------
+    from disq_tpu.runtime import mesh as mesh_mod
+    from disq_tpu.runtime.tracing import REGISTRY
+
+    if os.environ.get("DISQ_TPU_MESH"):
+        errors.append(
+            "DISQ_TPU_MESH leaked into the guard's env — the default "
+            "path must run single-device dispatch")
+    if mesh_mod.mesh_devices_requested(_Storage()) is not None:
+        errors.append(
+            "mesh_devices_requested(default storage) is not None — "
+            "resident reads would branch onto mesh code by default")
+    if mesh_mod.mesh_for_storage(_Storage()) is not None:
+        errors.append(
+            "mesh_for_storage(default storage) built a mesh — the "
+            "mesh-off path must construct no Mesh object")
+    if mesh_mod.mesh_if_built() is not None:
+        errors.append(
+            "a Mesh object exists with no mesh knob set — some default "
+            "code path constructed one")
+    if mesh_mod.service_devices() != [None]:
+        errors.append(
+            f"service_devices() = {mesh_mod.service_devices()} with "
+            "mesh off — the decode service must keep single default-"
+            "device dispatch (one sub-queue, no per-device state)")
+    for name in ("device.mesh.reshard_bytes",
+                 "device.mesh.exchange_bytes",
+                 "device.mesh.batches"):
+        if REGISTRY.counter(name).total() != 0:
+            errors.append(
+                f"{name} is nonzero on the mesh-off path — no bytes "
+                "may move and no batches may shard by default")
+
     # -- 2. timing: per-shard inline-executor overhead -----------------------
     sink = []
 

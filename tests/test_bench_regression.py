@@ -165,6 +165,53 @@ def test_cold_percentiles_are_not_guarded(cbr, tmp_path):
     assert not any("cold" in k for k in series)
 
 
+def _calib_cfg(fw, base, *, spread=0.02, base_spread=0.01, extra=None):
+    """Config-1-shaped round: framework value + the stdlib host ruler."""
+    cfgs = {"1_bam_decode": {"records_per_sec": fw, "spread": spread,
+                             "baseline_records_per_sec": base,
+                             "baseline_spread": base_spread}}
+    if extra:
+        cfgs.update(extra)
+    return cfgs
+
+
+def test_host_drift_normalizes_a_uniform_slowdown(cbr, tmp_path):
+    """Satellite: a round on a 0.6x container — ruler AND framework
+    both ~40% down — must pass: the drop is the machine, not the
+    code. Raw comparison would fail at -40% vs a 17% band."""
+    _round(tmp_path, 1, primary=2_000_000.0,
+           configs=_calib_cfg(2_000_000.0, 500_000.0))
+    _round(tmp_path, 2, primary=1_200_000.0,
+           configs=_calib_cfg(1_200_000.0, 300_000.0))
+    assert cbr.main(["--dir", str(tmp_path)]) == 0
+
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "HOST DRIFT" in proc.stdout
+
+
+def test_host_drift_does_not_mask_a_real_break(cbr, tmp_path):
+    """Drift mode widens bands, it does not disable them: a 5x drop
+    on a 0.6x container is still ~3x past any slack."""
+    _round(tmp_path, 1, primary=2_000_000.0,
+           configs=_calib_cfg(2_000_000.0, 500_000.0))
+    _round(tmp_path, 2, primary=400_000.0,
+           configs=_calib_cfg(400_000.0, 300_000.0))
+    assert cbr.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_stable_host_keeps_tight_bands(cbr, tmp_path):
+    """When the ruler holds still the full-precision band applies —
+    a 30% framework drop fails even though both rounds carry rulers."""
+    _round(tmp_path, 1, primary=2_000_000.0,
+           configs=_calib_cfg(2_000_000.0, 500_000.0))
+    _round(tmp_path, 2, primary=1_400_000.0,
+           configs=_calib_cfg(1_400_000.0, 495_000.0))
+    assert cbr.main(["--dir", str(tmp_path)]) == 1
+
+
 def test_new_and_retired_configs_never_fail(cbr, tmp_path):
     _round(tmp_path, 1, configs={"old": {"records_per_sec": 1000.0}})
     _round(tmp_path, 2, configs={"new": {"records_per_sec": 5.0}})

@@ -305,7 +305,12 @@ def _bam_file(tmp_path, n=150, blocksize=1500):
 
 
 class TestEndToEnd:
-    @pytest.mark.parametrize("workers", [1, 4])
+    # workers=4 (cross-shard coalescing, ~80s of interpret-mode
+    # launches) rides the slow tier: the routing contract is the
+    # workers=1 leg, and coalescing correctness is covered by
+    # TestServiceBatching at a fraction of the wall-clock.
+    @pytest.mark.parametrize("workers", [
+        1, pytest.param(4, marks=pytest.mark.slow)])
     def test_bam_read_byte_identity(self, tmp_path, monkeypatch, workers):
         """Full ReadsStorage.read with the decode service on: every
         shard's blocks route through the shared dispatcher and the
@@ -357,6 +362,10 @@ class TestEndToEnd:
         np.testing.assert_array_equal(dev.reads.pos, host.reads.pos)
         np.testing.assert_array_equal(dev.reads.seqs, host.reads.seqs)
 
+    # Slow tier (~65s e2e at workers=4): owner-only quarantine
+    # semantics stay tier-1 via TestServiceBatching's unit-level
+    # corrupt-lane test and test_resident_decode's faultfs bitflip.
+    @pytest.mark.slow
     def test_faultfs_corrupt_lane_quarantines_owner_only(
             self, tmp_path, monkeypatch):
         """A bit-flipped BGZF payload under faultfs, read at

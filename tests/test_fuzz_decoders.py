@@ -90,6 +90,10 @@ class TestSimdInflateFuzz:
         with pytest.raises(ValueError, match="corrupt DEFLATE"):
             inflate_payloads_simd(payloads, usizes=usizes, interpret=True)
 
+    # Slow tier (~90s of interpret-mode mutations): tier-1 keeps the
+    # random-garbage fuzz leg; the bitflip sweep runs with the soak
+    # wrapper.
+    @pytest.mark.slow
     def test_bitflipped_streams_detected_or_reproduced(self):
         """A mutated DEFLATE stream either errors somewhere in the
         device+fallback path, or yields exactly what host zlib yields —

@@ -151,6 +151,9 @@ class TestDynamicHuffman:
         ]
         check([deflate(r, 9) for r in raws], raws)
 
+    # Slow tier (~70s: a 16.5K-byte window in interpret mode); the
+    # other dynamic-Huffman legs keep the code-path tier-1.
+    @pytest.mark.slow
     def test_far_distance_28bit_path(self):
         # A match at distance ~16.5K uses dist symbol 29 (13 extra
         # bits); used once, it gets a long Huffman code, so code+extra
