@@ -39,6 +39,8 @@ from typing import BinaryIO, List, Optional, Tuple
 
 from disq_tpu.fsw.filesystem import FileSystemWrapper
 from disq_tpu.runtime.tracing import counter as _counter
+from disq_tpu.runtime.tracing import (
+    inject_trace_headers as _inject_trace_headers)
 from disq_tpu.runtime.tracing import observe_gauge as _observe_gauge
 from disq_tpu.runtime.tracing import span as _span
 
@@ -255,7 +257,8 @@ class HttpFileSystemWrapper(FileSystemWrapper):
         block."""
         def ranged_get():
             req = urllib.request.Request(
-                url, headers={"Range": f"bytes={start}-{end_incl}"})
+                url, headers=_inject_trace_headers(
+                    {"Range": f"bytes={start}-{end_incl}"}))
             with urllib.request.urlopen(
                     req, timeout=self._TIMEOUT_S) as resp:
                 if resp.status != 200:  # 206: the server honored Range
@@ -359,7 +362,8 @@ class HttpFileSystemWrapper(FileSystemWrapper):
         discipline as ``_fetch``: a stalled or 5xx HEAD must not hang a
         worker or misreport a live object as missing."""
         url = rewrite_remote_uri(path)
-        req = urllib.request.Request(url, method="HEAD")
+        req = urllib.request.Request(
+            url, headers=_inject_trace_headers({}), method="HEAD")
 
         def head():
             with urllib.request.urlopen(

@@ -45,7 +45,7 @@ from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from disq_tpu.runtime.tracing import REGISTRY, span
+from disq_tpu.runtime.tracing import REGISTRY, inject_trace_headers, span
 
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)$")
@@ -136,6 +136,7 @@ class WorkerState:
                                  float]] = []
         self.progress: Dict[str, Any] = {}
         self.healthz: Dict[str, Any] = {}
+        self.slo: Dict[str, Any] = {}
 
 
 class ClusterAggregator:
@@ -171,8 +172,10 @@ class ClusterAggregator:
         base = endpoint
         if "://" not in base:
             base = "http://" + base
+        req = urllib.request.Request(
+            base + path, headers=inject_trace_headers({}))
         with urllib.request.urlopen(
-                base + path,
+                req,
                 timeout=self.timeout_s if timeout_s is None
                 else timeout_s) as resp:
             return resp.read()
@@ -195,7 +198,19 @@ class ClusterAggregator:
                 REGISTRY.counter("cluster.scrape_errors").inc(
                     endpoint=worker.endpoint)
                 return
+            # /slo is newer than /metrics — a worker without the route
+            # (or with SLOs unconfigured) must still scrape clean.
+            try:
+                slo_raw = self._get(worker.endpoint, "/slo")
+            except Exception:  # noqa: BLE001 — optional endpoint
+                slo_raw = b"{}"
         worker.kinds, worker.samples = parse_metrics_text(metrics_raw)
+        try:
+            worker.slo = json.loads(slo_raw)
+            if not isinstance(worker.slo, dict):
+                worker.slo = {}
+        except ValueError:
+            worker.slo = {}
         try:
             worker.progress = json.loads(progress_raw)
         except ValueError:
@@ -424,6 +439,55 @@ class ClusterAggregator:
             "problems": problems,
         }
 
+    def slo(self, workers: Optional[List[WorkerState]] = None
+            ) -> Dict[str, Any]:
+        """Fleet SLO verdict: per-tenant worst burn across workers
+        (max — one hot replica pages, it does not average away), the
+        union of fast-burn tenants, per-process docs preserved."""
+        if workers is None:
+            workers = self._fresh()
+        tenants: Dict[str, Dict[str, Any]] = {}
+        processes: Dict[str, Any] = {}
+        enabled = False
+        for w in workers:
+            key = str(w.process_id if w.process_id is not None else -1)
+            if not w.ok:
+                processes[key] = {"endpoint": w.endpoint, "ok": False,
+                                  "error": w.error}
+                continue
+            doc = w.slo or {}
+            processes[key] = {"endpoint": w.endpoint, "ok": True,
+                              "slo": doc}
+            if not doc.get("enabled"):
+                continue
+            enabled = True
+            for tenant, tdoc in (doc.get("tenants") or {}).items():
+                agg = tenants.setdefault(str(tenant), {
+                    "worst_burn": 0.0, "fast_burn": False,
+                    "processes": [],
+                })
+                worst = 0.0
+                for wdoc in (tdoc.get("windows") or {}).values():
+                    worst = max(worst,
+                                float(wdoc.get("burn") or 0.0),
+                                float(wdoc.get("availability_burn")
+                                      or 0.0))
+                agg["worst_burn"] = round(
+                    max(agg["worst_burn"], worst), 4)
+                if tdoc.get("fast_burn"):
+                    agg["fast_burn"] = True
+                agg["processes"].append(key)
+        return {
+            "cluster": True,
+            "enabled": enabled,
+            "workers_ok": sum(1 for w in workers if w.ok),
+            "workers_total": len(workers),
+            "fast_burn_tenants": sorted(
+                t for t, d in tenants.items() if d["fast_burn"]),
+            "tenants": tenants,
+            "processes": processes,
+        }
+
     # -- fleet debug collection ---------------------------------------------
 
     def _collect_debug(self, path: str,
@@ -546,6 +610,12 @@ class ClusterAggregator:
                         200 if doc["status"] == "ok" else 503,
                         json.dumps(doc, default=str).encode(),
                         "application/json")
+                elif path == "/slo":
+                    self._send(
+                        200,
+                        json.dumps(aggregator.slo(workers),
+                                   default=str).encode(),
+                        "application/json")
                 elif path == "/debug/stacks":
                     self._send(
                         200,
@@ -569,7 +639,8 @@ class ClusterAggregator:
                     self._send(404, json.dumps({
                         "error": "unknown path",
                         "endpoints": ["/metrics", "/progress",
-                                      "/healthz", "/debug/stacks",
+                                      "/healthz", "/slo",
+                                      "/debug/stacks",
                                       "/debug/profile"]}).encode(),
                         "application/json")
 

@@ -67,8 +67,10 @@ import numpy as np
 from disq_tpu.runtime import flightrec as _flightrec
 from disq_tpu.runtime.tracing import (
     counter as _counter,
+    current_trace as _current_trace,
     observe_gauge as _observe_gauge,
     record_span as _record_span,
+    trace_scope as _trace_scope,
 )
 
 LANES = 128  # mirrors ops/inflate_simd.LANES (not imported: keep this
@@ -78,15 +80,19 @@ LANES = 128  # mirrors ops/inflate_simd.LANES (not imported: keep this
 class _Lane:
     """One block/stream queued for a kernel lane."""
 
-    __slots__ = ("sub", "index", "payload", "expect", "ts")
+    __slots__ = ("sub", "index", "payload", "expect", "ts", "trace")
 
     def __init__(self, sub: "Submission", index: int, payload: Any,
-                 expect: int, ts: float) -> None:
+                 expect: int, ts: float, trace: Any = None) -> None:
         self.sub = sub
         self.index = index
         self.payload = payload
         self.expect = expect
         self.ts = ts
+        # the submitting request's TraceContext (or None): rides the
+        # thread hop into the dispatcher so a coalesced launch can book
+        # each owner request's share of queue wait + launch time
+        self.trace = trace
 
 
 class Submission:
@@ -402,6 +408,7 @@ class DeviceDecodeService:
                   out=offsets[1:])
         sub = Submission(blob=np.empty(int(offsets[-1]), np.uint8),
                          offsets=offsets)
+        ctx = _current_trace()
         lanes: List[_Lane] = []
         for i, p in enumerate(payloads):
             if len(p) > IS.MAX_DEVICE_CSIZE:
@@ -411,7 +418,7 @@ class DeviceDecodeService:
                 sub.deliver_local(i, IS.host_inflate(p, int(usizes[i])))
             else:
                 # ts stamped at enqueue (see _enqueue)
-                lanes.append(_Lane(sub, i, p, int(usizes[i]), 0.0))
+                lanes.append(_Lane(sub, i, p, int(usizes[i]), 0.0, ctx))
         self._enqueue("inflate", lanes, sub)
         return sub
 
@@ -423,6 +430,7 @@ class DeviceDecodeService:
 
         n = len(streams)
         sub = Submission(parts_n=n)
+        ctx = _current_trace()
         lanes: List[_Lane] = []
         for k, s in enumerate(streams):
             meta = RS._parse_stream(k, s)
@@ -436,7 +444,7 @@ class DeviceDecodeService:
                     reason="oversize")
                 sub.deliver_local(k, RS._host_decode0(s))
                 continue
-            lanes.append(_Lane(sub, k, (s, meta), meta[0], 0.0))
+            lanes.append(_Lane(sub, k, (s, meta), meta[0], 0.0, ctx))
         self._enqueue("rans", lanes, sub)
         return sub
 
@@ -456,6 +464,7 @@ class DeviceDecodeService:
 
         n = len(payloads)
         sub = Submission(parts_n=n)
+        ctx = _current_trace()
         lanes: List[_Lane] = []
         for i, p in enumerate(payloads):
             if len(p) > BGZF_MAX_PAYLOAD:
@@ -467,7 +476,7 @@ class DeviceDecodeService:
                 hist = np.bincount(
                     np.frombuffer(p, np.uint8),
                     minlength=256).astype(np.int64)
-                lanes.append(_Lane(sub, i, (p, hist), len(p), 0.0))
+                lanes.append(_Lane(sub, i, (p, hist), len(p), 0.0, ctx))
         self._enqueue("deflate", lanes, sub)
         return sub
 
@@ -569,7 +578,15 @@ class DeviceDecodeService:
                     self._cond.wait(self._wait_s_locked())
             if chunk is not None:
                 kind, dev_i, lanes, reason = chunk
-                entry = self._launch(kind, dev_i, lanes, reason)
+                try:
+                    entry = self._launch(kind, dev_i, lanes, reason)
+                except BaseException as e:
+                    # the chunk is already out of the queues, so
+                    # _abort_all can't see it — fail its owners here
+                    # or they wait forever
+                    for lane in lanes:
+                        lane.sub.fail(e)
+                    raise
                 if entry is not None:
                     self._inflight.append(entry)
             if self._inflight and (chunk is None
@@ -629,6 +646,22 @@ class DeviceDecodeService:
         _record_span("device.service.wait",
                      time.perf_counter() - min(l.ts for l in lanes),
                      kind=kind, lanes=len(lanes))
+        # group lanes by owning request context (None = untraced): a
+        # coalesced launch serves n distinct requests, and each owner
+        # inherits its share of queue wait + launch time below
+        owners: Dict[Tuple[str, str, str], List[_Lane]] = {}
+        for lane in lanes:
+            if lane.trace is not None:
+                owners.setdefault(
+                    (lane.trace.trace_id, lane.trace.span_id,
+                     lane.trace.tenant), []).append(lane)
+        if owners:
+            # label is "requests", not "n" — Counter.inc's first
+            # positional is the increment amount named n, so a label
+            # called n would collide with it
+            _counter("device.batch.requests").inc(
+                requests=str(len(owners)))
+        t_launch = time.perf_counter()
         try:
             if dev is None:
                 handle = self._engines[kind].launch(lanes)
@@ -641,6 +674,16 @@ class DeviceDecodeService:
             for lane in lanes:
                 lane.sub.fail(e)
             return None
+        if owners:
+            launch_s = time.perf_counter() - t_launch
+            for own_lanes in owners.values():
+                share = launch_s * len(own_lanes) / len(lanes)
+                wait = t_launch - min(l.ts for l in own_lanes)
+                with _trace_scope(own_lanes[0].trace):
+                    _record_span("device.batch.share",
+                                 max(0.0, wait) + share, kind=kind,
+                                 lanes=len(own_lanes),
+                                 batch_lanes=len(lanes))
         return kind, handle, lanes
 
     def _materialize(self, entry) -> None:

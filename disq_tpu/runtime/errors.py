@@ -237,6 +237,13 @@ class DisqOptions:
     # default (32 blocks, or DISQ_TPU_HTTP_CACHE_BLOCKS); the locality
     # scorer reads occupancy off the fsw.http.cache.blocks gauge.
     http_cache_blocks: Optional[int] = None
+    # Per-tenant SLO spec (runtime/slo.py): comma-separated
+    # "tenant:latency_ms:target_pct[:availability_pct]" clauses ("*" =
+    # wildcard tenant). Arms the multi-window burn-rate evaluator whose
+    # snapshot /slo serves and /healthz merges (fast burn ⇒ degraded).
+    # Env equivalent: DISQ_TPU_SLO. None (default) starts no evaluator
+    # thread and touches nothing (check_overhead-guarded).
+    slo: Optional[str] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -341,6 +348,14 @@ class DisqOptions:
         if n < 1:
             raise ValueError(f"http_cache_blocks must be >= 1, got {n}")
         return replace(self, http_cache_blocks=int(n))
+
+    def with_slo(self, spec: str) -> "DisqOptions":
+        """Attach a per-tenant SLO spec (validated eagerly so a typo
+        fails at options-build time, not mid-serve)."""
+        from disq_tpu.runtime.slo import parse_slo_spec
+
+        parse_slo_spec(spec)  # raises ValueError on a malformed spec
+        return replace(self, slo=str(spec))
 
     def with_resident_decode(self, enable: bool = True) -> "DisqOptions":
         return replace(self, resident_decode=bool(enable))
@@ -795,6 +810,10 @@ def context_for_storage(storage, path: str) -> ShardErrorContext:
     # Arm the flight recorder before any shard work starts, so even a
     # fault in split planning happens with the event ring live.
     flightrec.configure_from_options(opts)
+    if getattr(opts, "slo", None):
+        from disq_tpu.runtime import slo as _slo
+
+        _slo.configure_from_options(opts)
     breaker = None
     if (getattr(opts, "retry_budget_tokens", None) is not None
             or getattr(opts, "breaker_window", None) is not None):

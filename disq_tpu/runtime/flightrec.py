@@ -55,7 +55,7 @@ import traceback
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from disq_tpu.runtime.tracing import REGISTRY, RUN_ID
+from disq_tpu.runtime.tracing import REGISTRY, RUN_ID, current_trace
 
 DEFAULT_RING = 4096       # events kept; overflow drops the oldest
 LEDGER_TAIL_BYTES = 65536  # per noted ledger file in a bundle
@@ -126,6 +126,12 @@ class FlightRecorder:
                "kind": kind}
         rec.update(fields)
         rec["kind"] = kind  # the event kind always wins the key
+        ctx = current_trace()
+        if ctx is not None:
+            # request-scoped causality: events recorded under an active
+            # trace context join that request's stitched timeline
+            rec.setdefault("trace", ctx.trace_id)
+            rec.setdefault("tenant", ctx.tenant)
         with self._lock:
             self._ring.append(rec)
         REGISTRY.counter("flightrec.events").inc(kind=kind)

@@ -349,6 +349,18 @@ class PipelineHealth:
             if any(b["state"] == "open"
                    for b in res.get("breakers", {}).values()):
                 doc["status"] = "degraded"
+        # Per-tenant SLO verdict (runtime/slo.py): a tenant burning its
+        # error budget past the fast-burn threshold on the short
+        # windows is a paging condition — the fleet aggregator and any
+        # LB health check see it here, not just on /slo.
+        from disq_tpu.runtime import slo as _slo
+
+        ev = _slo.evaluator_if_running()
+        if ev is not None:
+            frag = ev.health_fragment()
+            doc["slo"] = frag
+            if frag.get("fast_burn_tenants"):
+                doc["status"] = "degraded"
         return doc
 
     @staticmethod
@@ -623,8 +635,14 @@ class _Handler(BaseHTTPRequestHandler):
                     except ValueError:
                         pass
             ring = tracing.spans()
+            # epoch+mono pair: lets a cross-process stitcher
+            # (trace_report --request over live endpoints) align this
+            # process's monotonic span timestamps to wall clock
             self._send_json({
                 "run_id": RUN_ID,
+                "pid": os.getpid(),
+                "epoch": time.time(),
+                "mono": time.perf_counter(),
                 "dropped_spans":
                     counter("telemetry.dropped_spans").total(),
                 "total_in_ring": len(ring),
@@ -662,6 +680,15 @@ class _Handler(BaseHTTPRequestHandler):
                 }, 409)
             else:
                 self._send_json({"bundle": bundle, "run_id": RUN_ID})
+        elif path == "/slo":
+            # Per-tenant SLO view (runtime/slo.py): resolved lazily —
+            # the SLO-off path reports a disabled stub and never
+            # creates an evaluator.
+            from disq_tpu.runtime import slo
+
+            doc = slo.slo_doc()
+            doc["process_id"] = _process_id()
+            self._send_json(doc)
         elif path == "/serve/stats":
             # Serving plane (runtime/serve.py): resolved only when a
             # /serve/* request actually arrives, so the serve-off path
@@ -672,7 +699,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(body, code)
         else:
             self._send_json({"error": "unknown path", "endpoints": [
-                "/metrics", "/healthz", "/progress", "/spans",
+                "/metrics", "/healthz", "/progress", "/spans", "/slo",
                 "/debug/stacks", "/debug/profile", "/debug/bundle",
                 "/sched/stats", "/serve/stats"]},
                 404)
@@ -698,14 +725,23 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, OSError) as e:
             self._send_json({"error": f"bad request body: {e}"}, 400)
             return
-        if path.startswith("/sched/"):
-            from disq_tpu.runtime import scheduler
+        # Adopt the client's trace context (one header lookup when the
+        # caller sent none) for the whole dispatch, so every span the
+        # handled request emits on this thread carries its trace id.
+        ctx = tracing.trace_from_headers(self.headers)
+        token = tracing.activate_trace(ctx) if ctx is not None else None
+        try:
+            if path.startswith("/sched/"):
+                from disq_tpu.runtime import scheduler
 
-            code, body = scheduler.handle_http("POST", path, doc)
-        else:
-            from disq_tpu.runtime import serve
+                code, body = scheduler.handle_http("POST", path, doc)
+            else:
+                from disq_tpu.runtime import serve
 
-            code, body = serve.handle_http("POST", path, doc)
+                code, body = serve.handle_http("POST", path, doc)
+        finally:
+            if token is not None:
+                tracing.deactivate_trace(token)
         self._send_json(body, code)
 
     def _serve_profile(self, query: str) -> None:

@@ -125,6 +125,7 @@ from disq_tpu.runtime import flightrec
 from disq_tpu.runtime.tracing import (
     REGISTRY,
     counter,
+    inject_trace_headers,
     observe_gauge,
     record_span,
     span,
@@ -961,7 +962,8 @@ class SchedulerClient:
                 try:
                     req = urllib.request.Request(
                         url, data=body,
-                        headers={"Content-Type": "application/json"})
+                        headers=inject_trace_headers(
+                            {"Content-Type": "application/json"}))
                     with urllib.request.urlopen(
                             req, timeout=self.timeout_s) as resp:
                         return json.loads(resp.read())

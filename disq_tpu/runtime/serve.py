@@ -53,8 +53,11 @@ import zlib
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from disq_tpu.runtime.flightrec import record_event
 from disq_tpu.runtime.tracing import (
-    counter, gauge, histogram, record_span)
+    TraceContext, activate_trace, counter, current_trace,
+    deactivate_trace, gauge, histogram, mint_trace, record_span,
+    trace_requests_enabled)
 
 DEFAULT_TENANT = "anon"
 
@@ -103,6 +106,10 @@ class TenantAdmission:
         self._cond = threading.Condition()
         self._active: Dict[str, int] = {}
         self._queued: Dict[str, int] = {}
+        # tenant -> enqueue timestamps of waiters still in the queue,
+        # so /serve/stats can report head-of-line blocking (oldest
+        # waiter age) before a wait timeout fires
+        self._waiting: Dict[str, List[float]] = {}
 
     def acquire(self, tenant: str) -> None:
         adm = counter("serve.admission")
@@ -117,9 +124,10 @@ class TenantAdmission:
                     tenant,
                     f"{self._active.get(tenant, 0)} active, "
                     f"{self._queued.get(tenant, 0)} queued")
-            self._queued[tenant] = self._queued.get(tenant, 0) + 1
-            adm.inc(result="queued", tenant=tenant)
             t0 = time.perf_counter()
+            self._queued[tenant] = self._queued.get(tenant, 0) + 1
+            self._waiting.setdefault(tenant, []).append(t0)
+            adm.inc(result="queued", tenant=tenant)
             deadline = t0 + self.wait_timeout_s
             try:
                 while self._active.get(tenant, 0) >= self.slots:
@@ -131,6 +139,7 @@ class TenantAdmission:
                 self._active[tenant] = self._active.get(tenant, 0) + 1
             finally:
                 self._queued[tenant] -= 1
+                self._waiting[tenant].remove(t0)
                 record_span("serve.admission.wait",
                             time.perf_counter() - t0, tenant=tenant)
 
@@ -141,13 +150,17 @@ class TenantAdmission:
 
     def stats(self) -> Dict[str, Any]:
         with self._cond:
+            now = time.perf_counter()
             tenants = sorted(set(self._active) | set(self._queued))
             return {
                 "slots": self.slots,
                 "queue_depth": self.queue_depth,
                 "tenants": {
                     t: {"active": self._active.get(t, 0),
-                        "queued": self._queued.get(t, 0)}
+                        "queued": self._queued.get(t, 0),
+                        "oldest_wait_s": (
+                            round(now - min(self._waiting[t]), 6)
+                            if self._waiting.get(t) else 0.0)}
                     for t in tenants
                 },
             }
@@ -219,6 +232,8 @@ class HotBlockCache:
                 self._tenant_bytes[ek] = max(
                     0, self._tenant_bytes.get(ek, 0) - ev_bytes)
                 counter("serve.cache.evictions").inc(tier=tier)
+                record_event("serve_cache_evict", tier=tier,
+                             tenant=ev_tenant, nbytes=ev_bytes)
             gauge("serve.cache.bytes").observe(self._bytes[tier], tier=tier)
 
     def clear(self) -> None:
@@ -352,6 +367,14 @@ class ServeDaemon:
         self.admission = TenantAdmission(tenant_slots, tenant_queue)
         self._retrier = ShardRetrier(self._options.max_retries,
                                      self._options.retry_backoff_s)
+        quantile = getattr(self._options, "hedge_quantile", None)
+        if quantile is not None:
+            from disq_tpu.runtime.resilience import HedgeController
+
+            self._hedge: Optional[HedgeController] = HedgeController(
+                quantile, getattr(self._options, "hedge_min_s", 0.05))
+        else:
+            self._hedge = None
         self._datasets: Dict[str, _Dataset] = {}
         self._lock = threading.Lock()
 
@@ -455,9 +478,7 @@ class ServeDaemon:
             # miss: walk+stage the rest of the chunk in one range read
             # (retried through the shard retrier — transient storage
             # faults must not 500 a tenant)
-            blocks, data = self._retrier.call(
-                self._walk, ds.fs, ds.path, pos, want_end, length,
-                what="serve.fetch")
+            blocks, data = self._fetch(ds, pos, want_end, length)
             if not blocks:
                 break
             base = blocks[0].pos
@@ -487,6 +508,21 @@ class ServeDaemon:
 
         return _walk_blocks_collect(
             fs, path, pos, max(want_end, pos + 1), length)
+
+    def _fetch(self, ds: _Dataset, pos: int, want_end: int, length: int):
+        """One retried — and, when ``DisqOptions.hedge_quantile`` is
+        set, hedged — range fetch+walk: the query path's analogue of
+        the executor's hedged fetch stage, so a tail-latency storage
+        read races a duplicate and lands in the flight recorder as a
+        ``hedge_launched`` event on the serving plane."""
+        def call():
+            return self._retrier.call(
+                self._walk, ds.fs, ds.path, pos, want_end, length,
+                what="serve.fetch")
+
+        if self._hedge is None:
+            return call()
+        return self._hedge.call(call)
 
     def _inflate_pending(self, ds: _Dataset, pending, payloads, csizes,
                          tenant: str) -> None:
@@ -785,24 +821,61 @@ class ServeDaemon:
         tenant = str(doc.get("tenant") or DEFAULT_TENANT)
         t0 = time.perf_counter()
         endpoint = path.rsplit("/", 1)[-1]
+        # Request-scoped causality: adopt the client's context (already
+        # activated from X-Disq-Trace-* by the introspection handler),
+        # or mint a root one when DISQ_TPU_TRACE_REQUESTS is set.  The
+        # tenant rides the body, not the headers, so an adopted context
+        # is rebound to the body's tenant for per-tenant attribution.
+        ctx = current_trace()
+        token = None
+        if ctx is None:
+            if trace_requests_enabled():
+                ctx = mint_trace(tenant)
+                token = activate_trace(ctx)
+        elif ctx.tenant != tenant:
+            ctx = TraceContext(ctx.trace_id, ctx.span_id, tenant)
+            token = activate_trace(ctx)
         try:
-            self.admission.acquire(tenant)
-        except AdmissionShed as e:
-            return 429, {"error": str(e), "tenant": tenant}
-        try:
-            body = getattr(self, fn_name)(doc, tenant)
-            return 200, body
-        except (KeyError, ValueError) as e:
-            return 400, {"error": str(e)}
-        except FileNotFoundError as e:
-            return 404, {"error": f"not found: {e}"}
-        except Exception as e:  # noqa: BLE001 — surfaced as HTTP 500
-            return 500, {"error": f"{type(e).__name__}: {e}"}
+            try:
+                self.admission.acquire(tenant)
+            except AdmissionShed as e:
+                record_event("serve_shed", tenant=tenant,
+                             endpoint=endpoint, reason=e.reason)
+                if ctx is not None:
+                    record_span("serve.request.trace",
+                                time.perf_counter() - t0,
+                                endpoint=endpoint, tenant=tenant,
+                                status=429)
+                return 429, {"error": str(e), "tenant": tenant}
+            status = 500
+            try:
+                body = getattr(self, fn_name)(doc, tenant)
+                status = 200
+                return 200, body
+            except (KeyError, ValueError) as e:
+                status = 400
+                return 400, {"error": str(e)}
+            except FileNotFoundError as e:
+                status = 404
+                return 404, {"error": f"not found: {e}"}
+            except Exception as e:  # noqa: BLE001 — surfaced as HTTP 500
+                return 500, {"error": f"{type(e).__name__}: {e}"}
+            finally:
+                self.admission.release(tenant)
+                dur = time.perf_counter() - t0
+                histogram("serve.request").observe(
+                    dur, endpoint=endpoint, tenant=tenant)
+                if status >= 500:
+                    counter("serve.request.errors").inc(
+                        endpoint=endpoint, tenant=tenant)
+                if ctx is not None:
+                    # the stitched waterfall's root on this process
+                    record_span("serve.request.trace", dur,
+                                endpoint=endpoint, tenant=tenant,
+                                status=status)
         finally:
-            self.admission.release(tenant)
-            histogram("serve.request").observe(
-                time.perf_counter() - t0, endpoint=endpoint,
-                tenant=tenant)
+            if token is not None:
+                deactivate_trace(token)
 
 
 # -- module-level daemon lifecycle ----------------------------------------
@@ -827,6 +900,13 @@ def start_serve(port: int = 0, **daemon_kwargs: Any) -> str:
     with _LOCK:
         if _DAEMON is None:
             _DAEMON = ServeDaemon(**daemon_kwargs)
+    # The daemon is the serving edge the SLO layer watches, so it also
+    # arms the evaluator from DISQ_TPU_SLO — a bare start_serve() never
+    # passes through the DisqOptions storage funnel.  No-op (and no
+    # thread) when the env knob is unset.
+    from disq_tpu.runtime import slo as _slo
+
+    _slo.configure_from_env()
     from disq_tpu.runtime.introspect import start_introspect_server
 
     return start_introspect_server(port)
@@ -838,7 +918,9 @@ def stop_serve() -> None:
     plane, so the caller that started it stops it."""
     global _DAEMON
     with _LOCK:
-        _DAEMON = None
+        daemon, _DAEMON = _DAEMON, None
+    if daemon is not None and daemon._hedge is not None:
+        daemon._hedge.close()
 
 
 def handle_http(method: str, path: str,

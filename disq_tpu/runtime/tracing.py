@@ -68,6 +68,7 @@ from __future__ import annotations
 
 import atexit
 import contextlib
+import contextvars
 import json
 import logging
 import os
@@ -490,6 +491,170 @@ def telemetry_summary() -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# Request-scoped trace context: causal identity across threads/processes
+# ---------------------------------------------------------------------------
+#
+# A TraceContext is minted ONCE at the serving edge (or adopted from a
+# client's X-Disq-Trace-* headers) and carried via contextvars so every
+# span and flight-recorder event emitted under it — on this thread, or
+# on a hop the caller explicitly propagated to — is stamped with the
+# request's trace id.  Propagation is explicit and cheap:
+#
+# - HTTP hop (scheduler RPCs, fsw ranged GETs, cluster scrapes):
+#   ``inject_trace_headers(headers)`` adds the three headers when a
+#   context is active, and the receiving introspection handler re-
+#   activates it via ``trace_from_headers(self.headers)``.
+# - Thread hop (device-service submissions): the submitting thread's
+#   context rides on each queued lane and the dispatcher re-activates
+#   it per owner via ``trace_scope`` when booking that owner's share.
+#
+# Zero-overhead contract (scripts/check_overhead.py): with no context
+# active and DISQ_TPU_TRACE_REQUESTS unset, ``current_trace()`` is one
+# ContextVar read, ``inject_trace_headers`` adds nothing, and no trace
+# id is ever minted (``trace_ids_minted()`` stays 0).
+
+TRACE_ID_HEADER = "X-Disq-Trace-Id"
+TRACE_PARENT_HEADER = "X-Disq-Trace-Parent"
+TRACE_TENANT_HEADER = "X-Disq-Trace-Tenant"
+
+
+class TraceContext:
+    """Immutable causal identity of one request: the trace id shared by
+    every hop, the parent span/hop id that reached here, the tenant."""
+
+    __slots__ = ("trace_id", "span_id", "tenant")
+
+    def __init__(self, trace_id: str, span_id: str, tenant: str) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.tenant = tenant
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"TraceContext(trace_id={self.trace_id!r}, "
+                f"span_id={self.span_id!r}, tenant={self.tenant!r})")
+
+
+_trace_var: "contextvars.ContextVar[Optional[TraceContext]]" = (
+    contextvars.ContextVar("disq_tpu_trace", default=None))
+_trace_mint_lock = threading.Lock()
+_trace_ids_minted = 0
+_trace_span_seq = 0
+_trace_env_resolved = False
+_trace_requests = False
+
+
+def trace_requests_enabled() -> bool:
+    """True when ``DISQ_TPU_TRACE_REQUESTS`` is set truthy — the
+    serving edge then mints a trace for requests that arrive without
+    one.  Resolved once per process (explicit headers always win)."""
+    global _trace_env_resolved, _trace_requests
+    if not _trace_env_resolved:
+        with _trace_mint_lock:
+            if not _trace_env_resolved:
+                _trace_requests = os.environ.get(
+                    "DISQ_TPU_TRACE_REQUESTS", "").lower() not in (
+                        "", "0", "false", "off")
+                _trace_env_resolved = True
+    return _trace_requests
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The active request context, or None (the common, free case)."""
+    return _trace_var.get()
+
+
+def _mint_id(nbytes: int = 8) -> str:
+    global _trace_ids_minted
+    with _trace_mint_lock:
+        _trace_ids_minted += 1
+    return os.urandom(nbytes).hex()
+
+
+def trace_ids_minted() -> int:
+    """How many trace/span ids this process has minted — the overhead
+    guard asserts this stays 0 on the tracing-off path."""
+    with _trace_mint_lock:
+        return _trace_ids_minted
+
+
+def mint_trace(tenant: str) -> TraceContext:
+    """Mint a fresh root context at the serving edge."""
+    return TraceContext(_mint_id(8), _mint_id(4), str(tenant))
+
+
+def child_context(ctx: TraceContext) -> TraceContext:
+    """A hop-local context under ``ctx``'s trace: same trace id and
+    tenant, a fresh span/hop id (cheap sequence, not entropy — hop ids
+    only need uniqueness within one process's trace participation)."""
+    global _trace_span_seq
+    with _trace_mint_lock:
+        _trace_span_seq += 1
+        seq = _trace_span_seq
+    return TraceContext(ctx.trace_id, f"{RUN_ID}-{seq:x}", ctx.tenant)
+
+
+def activate_trace(ctx: TraceContext) -> "contextvars.Token":
+    """Make ``ctx`` the active context on this thread; returns the
+    token for ``deactivate_trace``."""
+    return _trace_var.set(ctx)
+
+
+def deactivate_trace(token: "contextvars.Token") -> None:
+    _trace_var.reset(token)
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Scope ``ctx`` (None = no-op) over a block — used by the device
+    dispatcher to book each owner's share under its own trace."""
+    if ctx is None:
+        yield
+        return
+    token = _trace_var.set(ctx)
+    try:
+        yield
+    finally:
+        _trace_var.reset(token)
+
+
+def inject_trace_headers(headers: Dict[str, str]) -> Dict[str, str]:
+    """Add ``X-Disq-Trace-*`` to an outbound header dict when a context
+    is active; with none active this is one ContextVar read and the
+    dict is returned untouched."""
+    ctx = _trace_var.get()
+    if ctx is not None:
+        headers[TRACE_ID_HEADER] = ctx.trace_id
+        headers[TRACE_PARENT_HEADER] = ctx.span_id
+        headers[TRACE_TENANT_HEADER] = ctx.tenant
+    return headers
+
+
+def trace_from_headers(headers: Any) -> Optional[TraceContext]:
+    """Parse an inbound context from HTTP headers (any mapping with
+    ``.get``, including ``http.client.HTTPMessage``); None when the
+    trace-id header is absent — one dict lookup on the off path."""
+    trace_id = headers.get(TRACE_ID_HEADER)
+    if not trace_id:
+        return None
+    return TraceContext(
+        str(trace_id),
+        str(headers.get(TRACE_PARENT_HEADER) or ""),
+        str(headers.get(TRACE_TENANT_HEADER) or "anon"))
+
+
+def reset_trace_state() -> None:
+    """Test hook: forget the env resolution and zero the mint counter
+    (any active context on the calling thread is left alone)."""
+    global _trace_env_resolved, _trace_requests, _trace_ids_minted
+    global _trace_span_seq
+    with _trace_mint_lock:
+        _trace_env_resolved = False
+        _trace_requests = False
+        _trace_ids_minted = 0
+        _trace_span_seq = 0
+
+
+# ---------------------------------------------------------------------------
 # Span timeline: bounded ring + optional JSONL sink
 # ---------------------------------------------------------------------------
 
@@ -603,6 +768,11 @@ def _emit_span(name: str, ts: float, dur: float,
     REGISTRY.histogram(name).observe(dur)
     rec = {"ts": round(ts, 6), "dur": round(dur, 6), "name": name,
            "run": RUN_ID, "labels": labels}
+    ctx = _trace_var.get()
+    if ctx is not None:
+        rec["trace"] = ctx.trace_id
+        rec["parent"] = ctx.span_id
+        rec["tenant"] = ctx.tenant
     # Serialize outside the lock (unlocked sink check is benign: worst
     # case one wasted dumps around a concurrent start/stop).
     line = (json.dumps(rec, default=str) + "\n"

@@ -69,6 +69,9 @@ ALLOWED_PREFIXES = {
     # two-tier hot-block cache accounting, index-cache hit/miss, and
     # per-tenant admission results + queue-wait spans.
     "serve",
+    # Per-tenant SLO layer (runtime/slo.py): multi-window burn-rate
+    # gauges, the fast-burn page flag, and evaluator tick counter.
+    "slo",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

@@ -84,7 +84,8 @@ def main() -> int:
         t.name for t in threading.enumerate()
         if t.name.startswith(
             ("disq-watchdog", "disq-introspect", "disq-device",
-             "disq-hostwork", "disq-profiler", "disq-serve"))
+             "disq-hostwork", "disq-profiler", "disq-serve",
+             "disq-slo"))
     ]
     if bad_threads:
         errors.append(f"stray observability threads: {bad_threads}")
@@ -249,6 +250,36 @@ def main() -> int:
             errors.append(
                 f"{name} is nonzero on the mesh-off path — no bytes "
                 "may move and no batches may shard by default")
+
+    # -- 1e. request tracing + SLOs: unconfigured ⇒ nothing minted -----------
+    from disq_tpu.runtime import slo as slo_mod
+    from disq_tpu.runtime import tracing as tracing_mod
+
+    if tracing_mod.trace_requests_enabled():
+        errors.append(
+            "DISQ_TPU_TRACE_REQUESTS leaked into the guard's env — the "
+            "serving edge must mint no trace ids by default")
+    if tracing_mod.current_trace() is not None:
+        errors.append(
+            "a trace context is active with nothing configured — the "
+            "default path must carry an empty ContextVar")
+    probe_headers = {"Range": "bytes=0-1"}
+    if tracing_mod.inject_trace_headers(dict(probe_headers)) \
+            != probe_headers:
+        errors.append(
+            "inject_trace_headers added headers with no active trace — "
+            "every HTTP hop would grow bytes on the default path")
+    if tracing_mod.trace_ids_minted() != 0:
+        errors.append(
+            f"{tracing_mod.trace_ids_minted()} trace ids minted on the "
+            "tracing-off path (the 1b4 serve exercise ran with tracing "
+            "unconfigured) — the serving hot path must mint no uuids "
+            "by default")
+    if slo_mod.evaluator_if_running() is not None:
+        errors.append(
+            "an SLO evaluator is running with no DISQ_TPU_SLO / "
+            "DisqOptions.slo configured — the default path must start "
+            "no disq-slo thread")
 
     # -- 2. timing: per-shard inline-executor overhead -----------------------
     sink = []

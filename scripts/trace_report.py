@@ -35,6 +35,21 @@ Usage::
     python scripts/trace_report.py progress.jsonl --progress
     python scripts/trace_report.py profile.collapsed --flame
     python scripts/trace_report.py --postmortem <bundle-dir>
+    python scripts/trace_report.py a.jsonl b.jsonl host:port \\
+        --request <trace_id>
+
+``--request <trace_id>`` is the cross-process stitcher: every input
+(span JSONL files and/or live ``host:port`` introspection endpoints,
+freely mixed) contributes the spans stamped with that request's trace
+id, each source's monotonic timestamps are aligned to the epoch via
+its meta lines (files) or the ``/spans`` response's ``epoch``/``mono``
+pair (live), and the result is ONE waterfall for the request's whole
+distributed life: serving-edge root span, admission wait, device-batch
+share, scheduler RPCs — whichever processes touched it.  Below the
+waterfall: the fraction of client wall-clock covered by spans, and
+every uncovered gap attributed as ``hop`` (the bounding spans live in
+different processes — network/queue handoff) or ``intra``
+(uninstrumented time inside one process).
 
 ``--chrome`` additionally converts the spans to Chrome/Perfetto
 ``trace_event`` JSON (open in chrome://tracing or ui.perfetto.dev;
@@ -828,6 +843,179 @@ def postmortem_report(bundle: str, top: int, width: int) -> str:
 
 
 # ---------------------------------------------------------------------------
+# --request: stitch one request's spans from N processes into a single
+# epoch-aligned waterfall with coverage + per-hop gap attribution
+# ---------------------------------------------------------------------------
+
+
+def _load_trace_source_file(path: str):
+    """One span JSONL as a stitcher source: ``(label, offset, spans)``.
+
+    ``offset`` maps the writer's monotonic clock to the epoch
+    (``epoch_time = ts + offset``), read from the file's meta lines
+    (``{"meta": 1, "epoch": ..., "mono": ...}``).  A file that several
+    process incarnations appended to carries one meta line per
+    incarnation — each span is stamped with the offset of the meta
+    line above it (``_off``), so restarts don't skew alignment."""
+    spans: List[Dict[str, Any]] = []
+    offset: Optional[float] = None
+    pid = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line
+            if rec.get("meta"):
+                epoch, mono = rec.get("epoch"), rec.get("mono")
+                if isinstance(epoch, (int, float)) and isinstance(
+                        mono, (int, float)):
+                    offset = float(epoch) - float(mono)
+                if rec.get("pid") is not None:
+                    pid = rec["pid"]
+                continue
+            if "name" not in rec or "ts" not in rec:
+                continue
+            rec["_off"] = offset
+            spans.append(rec)
+    label = f"pid{pid}" if pid is not None else os.path.basename(path)
+    return label, offset, spans
+
+
+def _load_trace_source_endpoint(endpoint: str):
+    """A live introspection endpoint as a stitcher source: fetch
+    ``/spans`` (which reports the serving process's ``pid`` and an
+    ``epoch``/``mono`` clock pair alongside the span ring)."""
+    import urllib.request
+
+    base = endpoint if "://" in endpoint else "http://" + endpoint
+    with urllib.request.urlopen(base + "/spans", timeout=5) as resp:
+        doc = json.loads(resp.read())
+    offset: Optional[float] = None
+    epoch, mono = doc.get("epoch"), doc.get("mono")
+    if isinstance(epoch, (int, float)) and isinstance(
+            mono, (int, float)):
+        offset = float(epoch) - float(mono)
+    pid = doc.get("pid")
+    label = f"pid{pid}" if pid is not None else endpoint
+    return label, offset, list(doc.get("spans") or [])
+
+
+def load_trace_sources(inputs: List[str]):
+    """Resolve each CLI input to a stitcher source: an existing path is
+    read as a span JSONL, anything else is treated as a live
+    ``host:port`` endpoint."""
+    sources = []
+    for inp in inputs:
+        if os.path.exists(inp):
+            sources.append(_load_trace_source_file(inp))
+        else:
+            sources.append(_load_trace_source_endpoint(inp))
+    return sources
+
+
+def request_report(sources, trace_id: str, width: int) -> str:
+    """The stitched cross-process waterfall for one trace id (see the
+    module doc's ``--request`` section)."""
+    rows: List[Dict[str, Any]] = []
+    unaligned = False
+    for label, default_off, spans in sources:
+        for s in spans:
+            if s.get("trace") != trace_id:
+                continue
+            off = s.get("_off")
+            if off is None:
+                off = default_off
+            if off is None:
+                off = 0.0
+                unaligned = True
+            try:
+                t = float(s["ts"]) + off
+                dur = max(0.0, float(s.get("dur") or 0.0))
+            except (TypeError, ValueError):
+                continue
+            rows.append({
+                "t": t, "dur": dur, "name": s["name"], "src": label,
+                "tenant": s.get("tenant"),
+                "labels": s.get("labels") or {},
+            })
+    if not rows:
+        return f"no spans found for trace {trace_id}\n"
+    rows.sort(key=lambda r: (r["t"], -r["dur"]))
+    t0 = min(r["t"] for r in rows)
+    t1 = max(r["t"] + r["dur"] for r in rows)
+    wall = max(t1 - t0, 1e-9)
+    procs = sorted({r["src"] for r in rows})
+    tenants = sorted({r["tenant"] for r in rows if r.get("tenant")})
+    out: List[str] = []
+    out.append(
+        f"trace {trace_id}  ({len(rows)} spans across "
+        f"{len(procs)} process{'es' if len(procs) != 1 else ''}: "
+        + ", ".join(procs)
+        + (f"; tenant {', '.join(tenants)}" if tenants else "") + ")")
+    out.append(f"client wall-clock {wall * 1e3:.2f}ms (epoch-aligned)")
+    if unaligned:
+        out.append(
+            "WARNING: a source carries no epoch/mono clock pair — its "
+            "spans are unaligned (offset 0); cross-process ordering "
+            "may be wrong")
+    out.append("")
+    scale = width / wall
+    src_w = max(len(r["src"]) for r in rows)
+    name_w = max(len(r["name"]) for r in rows)
+    for r in rows:
+        cells = [" "] * width
+        a = int((r["t"] - t0) * scale)
+        b = max(a + 1, int((r["t"] + r["dur"] - t0) * scale))
+        for i in range(a, min(b, width)):
+            cells[i] = "#"
+        detail = " ".join(
+            f"{k}={r['labels'][k]}" for k in
+            ("endpoint", "status", "kind", "lanes", "batch_lanes")
+            if k in r["labels"])
+        out.append(
+            f"  {r['src']:>{src_w}} {r['name']:<{name_w}} "
+            f"|{''.join(cells)}| {r['dur'] * 1e3:8.2f}ms"
+            + (f"  {detail}" if detail else ""))
+    out.append("")
+
+    # -- coverage: union of span intervals over the trace window ------------
+    ivals = sorted((r["t"], r["t"] + r["dur"]) for r in rows)
+    covered = 0.0
+    gaps: List[Any] = []
+    cur_s, cur_e = ivals[0]
+    for s, e in ivals[1:]:
+        if s > cur_e:
+            gaps.append((cur_e, s))
+            covered += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    covered += cur_e - cur_s
+    out.append(
+        f"coverage: {covered / wall * 100:.1f}% of client wall-clock "
+        f"instrumented ({covered * 1e3:.2f}ms of {wall * 1e3:.2f}ms)")
+    if gaps:
+        out.append("gap attribution (uninstrumented wall-clock)")
+        for gs, ge in sorted(gaps, key=lambda g: g[0] - g[1])[:10]:
+            before = max(
+                (r for r in rows if r["t"] + r["dur"] <= gs + 1e-9),
+                key=lambda r: r["t"] + r["dur"])
+            after = min((r for r in rows if r["t"] >= ge - 1e-9),
+                        key=lambda r: r["t"])
+            kind = ("hop" if before["src"] != after["src"]
+                    else "intra")
+            out.append(
+                f"  {(ge - gs) * 1e3:8.2f}ms  {kind:<5} "
+                f"{before['src']}/{before['name']} -> "
+                f"{after['src']}/{after['name']}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # --progress: replay a progress JSONL (DisqOptions.progress_log) into a
 # throughput-over-time sparkline
 # ---------------------------------------------------------------------------
@@ -935,12 +1123,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-shard waterfall + latency report from a "
                     "disq_tpu span JSONL")
-    ap.add_argument("jsonl", nargs="?", default=None,
+    ap.add_argument("inputs", nargs="*", default=[], metavar="input",
                     help="span log written via "
                     "DISQ_TPU_TRACE_JSONL / DisqOptions.span_log "
                     "(with --progress, a DisqOptions.progress_log "
                     "JSONL; with --flame, a collapsed-stack profile; "
-                    "unused with --postmortem)")
+                    "unused with --postmortem; with --request, any "
+                    "mix of span JSONLs and live host:port "
+                    "introspection endpoints)")
     ap.add_argument("--top", type=int, default=5,
                     help="straggler shards to list (default 5)")
     ap.add_argument("--width", type=int, default=72,
@@ -966,6 +1156,11 @@ def main(argv=None) -> int:
                     help="render a flight-recorder postmortem bundle "
                     "directory (DisqOptions.postmortem_dir) into a "
                     "one-page verdict")
+    ap.add_argument("--request", default=None, metavar="TRACE_ID",
+                    help="stitch one request's spans from every input "
+                    "(span JSONLs and/or live host:port endpoints) "
+                    "into a single cross-process waterfall with "
+                    "coverage + gap attribution")
     args = ap.parse_args(argv)
 
     if args.postmortem:
@@ -973,26 +1168,33 @@ def main(argv=None) -> int:
             postmortem_report(args.postmortem, args.top, args.width))
         return 0
 
-    if args.jsonl is None:
+    if not args.inputs:
         ap.error("an input file is required (or use --postmortem "
                  "<bundle-dir>)")
 
+    if args.request:
+        sys.stdout.write(request_report(
+            load_trace_sources(args.inputs), args.request, args.width))
+        return 0
+
+    path = args.inputs[0]
+
     if args.flame:
         sys.stdout.write(flame_report(
-            load_collapsed(args.jsonl), args.top, args.width))
+            load_collapsed(path), args.top, args.width))
         return 0
 
     if args.progress:
-        recs, run, runs = load_progress(args.jsonl, args.run)
+        recs, run, runs = load_progress(path, args.run)
         sys.stdout.write(progress_report(recs, run, runs, args.width))
         return 0
 
     if args.analyze:
-        spans, run, runs, dropped = load_spans(args.jsonl, args.run)
+        spans, run, runs, dropped = load_spans(path, args.run)
         sys.stdout.write(analyze(spans, run, runs, dropped))
         return 0
 
-    spans, run, runs, dropped = load_spans(args.jsonl, args.run)
+    spans, run, runs, dropped = load_spans(path, args.run)
     sys.stdout.write(report(spans, run, runs, args.top, args.width,
                             dropped))
     if args.chrome:

@@ -337,3 +337,90 @@ class TestEndToEnd:
             capture_output=True, text=True, timeout=60)
         assert proc.returncode == 0, proc.stderr
         assert 'disq_tpu_progress_records{process="1"} 300' in proc.stdout
+
+
+# -- serving-plane + SLO fleet merge ----------------------------------------
+
+
+def _serve_worker(pid, cache_hits, sheds):
+    """A WorkerState whose exposition carries serving-plane counters."""
+    w = WorkerState(f"s{pid}:1")
+    w.ok = True
+    w.process_id = pid
+    w.kinds, w.samples = parse_metrics_text(
+        "# TYPE disq_tpu_serve_cache_hits counter\n"
+        f'disq_tpu_serve_cache_hits{{tier="parsed",tenant="t0"}} '
+        f"{cache_hits}\n"
+        "# TYPE disq_tpu_serve_admission counter\n"
+        f'disq_tpu_serve_admission{{result="shed",tenant="t0"}} '
+        f"{sheds}\n")
+    w.healthz = {"status": "ok"}
+    return w
+
+
+class TestServeFleetViews:
+    def _agg(self):
+        return ClusterAggregator(["s0:1", "s1:1"])
+
+    def test_serve_metrics_rollup_across_replicas(self):
+        """Satellite: serve.* counters from two replicas merge with
+        per-process labels AND unlabeled rollup sums, so fleet
+        dashboards see both the hot replica and the total."""
+        workers = [_serve_worker(0, 40, 3), _serve_worker(1, 25, 2)]
+        text = self._agg().metrics_text(workers)
+        _kinds, samples = parse_metrics_text(text)
+
+        def by(name):
+            return {tuple(sorted(ls)): v
+                    for n, ls, v in samples if n == name}
+
+        hits = by("disq_tpu_serve_cache_hits")
+        assert hits[(("process", "0"), ("tenant", "t0"),
+                     ("tier", "parsed"))] == 40.0
+        assert hits[(("process", "1"), ("tenant", "t0"),
+                     ("tier", "parsed"))] == 25.0
+        assert hits[(("tenant", "t0"), ("tier", "parsed"))] == 65.0
+        sheds = by("disq_tpu_serve_admission")
+        assert sheds[(("process", "0"), ("result", "shed"),
+                      ("tenant", "t0"))] == 3.0
+        assert sheds[(("result", "shed"), ("tenant", "t0"))] == 5.0
+
+    def test_slo_fleet_merge_takes_worst_burn(self):
+        """Per-tenant fleet burn is the MAX across replicas (one hot
+        replica pages; a mean would hide it) and fast-burn tenants are
+        the union."""
+        w0, w1 = _serve_worker(0, 1, 0), _serve_worker(1, 1, 0)
+        w0.slo = {"enabled": True, "tenants": {
+            "t0": {"fast_burn": True, "windows": {
+                "60": {"burn": 20.0, "availability_burn": None},
+                "300": {"burn": 15.0, "availability_burn": None}}},
+        }}
+        w1.slo = {"enabled": True, "tenants": {
+            "t0": {"fast_burn": False, "windows": {
+                "60": {"burn": 0.5, "availability_burn": 1.5}}},
+            "t1": {"fast_burn": False, "windows": {
+                "60": {"burn": 0.0, "availability_burn": 0.2}}},
+        }}
+        doc = self._agg().slo([w0, w1])
+        assert doc["cluster"] is True and doc["enabled"] is True
+        assert doc["workers_ok"] == 2
+        assert doc["fast_burn_tenants"] == ["t0"]
+        assert doc["tenants"]["t0"]["worst_burn"] == 20.0
+        assert doc["tenants"]["t0"]["fast_burn"] is True
+        assert doc["tenants"]["t0"]["processes"] == ["0", "1"]
+        assert doc["tenants"]["t1"]["worst_burn"] == 0.2
+        assert set(doc["processes"]) == {"0", "1"}
+
+    def test_slo_merge_with_unreachable_and_disabled(self):
+        w0 = _serve_worker(0, 1, 0)
+        w0.slo = {"enabled": False, "tenants": {}}
+        dead = WorkerState("s1:1")
+        dead.ok = False
+        dead.error = "ConnectionRefusedError: x"
+        doc = self._agg().slo([w0, dead])
+        assert doc["enabled"] is False
+        assert doc["tenants"] == {}
+        assert doc["processes"]["0"]["ok"] is True
+        dead_doc = [p for p in doc["processes"].values()
+                    if not p["ok"]]
+        assert dead_doc and "ConnectionRefused" in dead_doc[0]["error"]
