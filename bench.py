@@ -1212,6 +1212,275 @@ def serve_latency_config(path: str, tmp: str) -> dict:
     return {"13_serve_latency": rows}
 
 
+# Replica subprocess for config 15: a real serving daemon in its own
+# interpreter, capacity-constrained caches, optional seeded slow-tail
+# (sleep wrapped around the query path — models a replica with a cold
+# page cache / noisy neighbor). Prints its address then holds on stdin.
+_FLEET_REPLICA_CODE = r"""
+import json, os, sys, time
+cfg = json.loads(sys.argv[1])
+sys.path.insert(0, cfg["repo"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from disq_tpu.runtime import serve as serve_mod
+addr = serve_mod.start_serve(
+    port=0, tenant_slots=64, tenant_queue=256,
+    compressed_cache_mb=cfg["compressed_mb"],
+    decoded_cache_mb=cfg["decoded_mb"],
+    parsed_cache_mb=cfg["parsed_mb"])
+daemon = serve_mod.serve_if_running()
+daemon.register("bench", cfg["bam"])
+if cfg.get("slow_s"):
+    _orig = daemon.handle
+    def _slow_handle(method, p, doc, _orig=_orig, _s=cfg["slow_s"]):
+        if p.startswith("/query/"):
+            time.sleep(_s)
+        return _orig(method, p, doc)
+    daemon.handle = _slow_handle
+print("ADDR", addr, flush=True)
+sys.stdin.readline()
+"""
+
+
+def fleet_serve_config(path: str, tmp: str) -> dict:
+    """Config 15: the fleet routing tier (``runtime/fleet.py``) over
+    real serving subprocesses — the config 13 closed-loop Zipf
+    workload replayed against 2 replicas behind the router, locality
+    routing vs random, plus cross-replica hedging against a seeded
+    slow-tail replica.
+
+    The per-replica cache budgets are **calibrated**: a single
+    in-process daemon first warms the full 64-region working set and
+    each replica then gets ~55% of the measured bytes per tier — the
+    hot set fits the fleet's aggregate cache only when locality
+    routing *partitions* it (each replica keeps the regions the
+    rendezvous/overlap signal pins to it), while random routing asks
+    every replica to hold everything and thrashes both LRUs. The
+    guarded leaves are the locality hot ``p99_ms`` (lower is better)
+    and ``qps`` at c=32; the random side is informational
+    (``baseline_*``) and ``locality_over_random_p99_x`` is the
+    headline. The ``hedge`` sub-row adds a third replica with a
+    seeded 80ms stall on every query and reports how many hedges
+    launched and how often the duplicate beat the slow primary."""
+    import http.client
+    import random
+    import subprocess
+    import threading as _threading
+    import statistics as _stats
+
+    from disq_tpu import (
+        BaiWriteOption, ReadsStorage, SbiWriteOption, stop_introspect_server)
+    from disq_tpu.runtime import serve as serve_mod
+    from disq_tpu.runtime.introspect import introspect_address
+    from disq_tpu.runtime.tracing import counter
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    indexed = os.path.join(tmp, "bench-fleet.bam")
+    st = ReadsStorage.make_default().num_shards(8)
+    st.write(st.read(path), indexed, BaiWriteOption.ENABLE,
+             SbiWriteOption.ENABLE, sort=True)
+
+    # Wider regions than config 13 (40 kbp): a cache miss decodes ~2x
+    # the blocks while a parsed-tier hit stays O(lookup) — the
+    # hit-vs-miss cost gap IS the signal this config measures.
+    rng = random.Random(15)
+    span = 40_000
+    regions = [(REFS[rng.randrange(len(REFS))][0],
+                rng.randrange(0, 1_000_000 - span))
+               for _ in range(64)]
+    weights = [1.0 / (i + 1) for i in range(len(regions))]
+
+    def run_clients(addr: str, qpath: str, c: int,
+                    requests_per_client: int, seed: int,
+                    region_pool=None, pool_weights=None):
+        """Config 13's closed loop, parameterized by target address
+        and query path (replica-direct or through the router)."""
+        pool = region_pool or regions
+        wts = pool_weights or weights[:len(pool)]
+        lat_lists = [[] for _ in range(c)]  # (region rank, latency s)
+        errors = []
+
+        def client(k):
+            import socket as _socket
+
+            crng = random.Random(seed * 1000 + k)
+            host, _, port = addr.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=60)
+            try:
+                conn.connect()
+                conn.sock.setsockopt(
+                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                for _ in range(requests_per_client):
+                    rank = crng.choices(range(len(pool)), wts)[0]
+                    contig, start = pool[rank]
+                    body = json.dumps({
+                        "dataset": "bench", "tenant": f"t{k % 4}",
+                        "limit": 0, "digest": False,
+                        "intervals": [{"contig": contig, "start": start + 1,
+                                       "end": start + span}],
+                    })
+                    t0 = time.perf_counter()
+                    conn.request("POST", qpath, body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    lat_lists[k].append((rank, time.perf_counter() - t0))
+                    if resp.status != 200:
+                        errors.append(
+                            f"client {k}: {resp.status} {payload[:200]}")
+                        return
+            except Exception as e:
+                errors.append(f"client {k}: {type(e).__name__}: {e}")
+            finally:
+                conn.close()
+
+        threads = [_threading.Thread(target=client, args=(k,))
+                   for k in range(c)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"config 15 client errors: {errors[:3]}")
+        return [x for lst in lat_lists for x in lst], wall
+
+    N_HOT = 8  # Zipf head: ~50% of the traffic mass
+
+    def pcts(samples, wall):
+        def pc(lats, p):
+            return lats[min(len(lats) - 1, int(p / 100 * len(lats)))]
+        lats = sorted(lat for _rank, lat in samples)
+        hot = sorted(lat for rank, lat in samples if rank < N_HOT)
+        return {"p50_ms": pc(lats, 50) * 1e3, "p99_ms": pc(lats, 99) * 1e3,
+                "hot_p99_ms": pc(hot or lats, 99) * 1e3,
+                "qps": len(lats) / wall}
+
+    # --- calibration: size the full working set with one daemon -----------
+    owns_server = introspect_address() is None
+    serve_mod.start_serve(tenant_slots=64, tenant_queue=256)
+    daemon = serve_mod.serve_if_running()
+    daemon.register("bench", indexed)
+    for contig, start in regions:
+        status, _body = daemon.handle("POST", "/query/reads", {
+            "dataset": "bench", "limit": 0, "digest": False,
+            "intervals": [{"contig": contig, "start": start + 1,
+                           "end": start + span}]})
+        assert status == 200, _body
+    cstats = daemon.cache.stats()
+    serve_mod.stop_serve()
+    # ~55% of the measured set per tier (>=1 MB): a rendezvous
+    # partition gives each replica ~half the regions, which fits —
+    # locality routing reaches a near-zero steady-state miss rate —
+    # while random routing asks every replica to hold 100% of the set
+    # and keeps thrashing the Zipf tail out of both LRUs.
+    budgets = {
+        f"{tier}_mb": max(1, int(cstats[tier]["bytes"] * 0.55) >> 20)
+        for tier in ("compressed", "decoded", "parsed")}
+
+    def spawn_replica(slow_s: float = 0.0):
+        cfg = dict(budgets, repo=repo, bam=indexed, slow_s=slow_s)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _FLEET_REPLICA_CODE, json.dumps(cfg)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        line = proc.stdout.readline()
+        if not line.startswith("ADDR"):
+            proc.kill()
+            raise RuntimeError(f"config 15 replica failed to start: {line!r}")
+        return proc, line.split()[1]
+
+    from disq_tpu.runtime import fleet as fleet_mod
+
+    rows: dict = {"regions": len(regions), "span_bp": span,
+                  "replica_cache_mb": budgets}
+    procs = []
+    try:
+        for _ in range(2):
+            procs.append(spawn_replica())
+        addrs = [a for _p, a in procs]
+        c, n_req = 32, max(96, 24 * 32) // 32
+
+        # --- locality vs random routing, same replicas, cold per phase ----
+        for policy in ("locality", "random"):
+            fleet_addr = fleet_mod.start_fleet(
+                addrs, policy=policy, hedge_quantile=None, refresh_s=0.25)
+            router = fleet_mod.fleet_if_running()
+            status, doc = router.register("bench", indexed)
+            assert status == 200, doc  # epoch bump => replicas start cold
+            run_clients(fleet_addr, "/fleet/query/reads", c, n_req,
+                        seed=c)  # warm: caches fill along routed paths
+            reps = [pcts(*run_clients(fleet_addr, "/fleet/query/reads",
+                                      c, n_req, seed=c))
+                    for _ in range(3)]
+            med = {k: _stats.median(r[k] for r in reps) for k in reps[0]}
+            if policy == "locality":
+                rows["locality"] = {
+                    "p50_ms": round(med["p50_ms"], 3),
+                    "p99_ms": round(med["p99_ms"], 3),
+                    "spread": _spread([r["p99_ms"] for r in reps]),
+                    "hot_p99_ms": round(med["hot_p99_ms"], 3),
+                    "qps": round(med["qps"], 1),
+                    "qps_spread": _spread([r["qps"] for r in reps]),
+                }
+            else:  # baseline_* keys: informational, not regression-gated
+                rows["random"] = {
+                    "baseline_p50_ms": round(med["p50_ms"], 3),
+                    "baseline_p99_ms": round(med["p99_ms"], 3),
+                    "baseline_hot_p99_ms": round(med["hot_p99_ms"], 3),
+                    "baseline_qps": round(med["qps"], 1),
+                }
+            fleet_mod.stop_fleet()
+        # The headline: tail latency on the *hot set* — the queries
+        # locality routing keeps pinned to a warm replica while random
+        # routing lets the Zipf tail churn them out of every LRU.
+        rows["locality_over_random_hot_p99_x"] = round(
+            rows["random"]["baseline_hot_p99_ms"]
+            / max(rows["locality"]["hot_p99_ms"], 1e-9), 2)
+
+        # --- hedging: add a seeded slow-tail replica ----------------------
+        # 250ms stall: decisively slower than a CPU-contended cold
+        # decode on the runner-up, so the duplicate can actually win.
+        slow = spawn_replica(slow_s=0.25)
+        procs.append(slow)
+        fleet_addr = fleet_mod.start_fleet(
+            addrs + [slow[1]], policy="locality",
+            hedge_quantile=0.9, hedge_min_s=0.02, refresh_s=0.25)
+        router = fleet_mod.fleet_if_running()
+        status, doc = router.register("bench", indexed)
+        assert status == 200, doc
+        # Warm ONLY the slow replica over the hot regions: locality then
+        # pins the hot set to it, so its seeded stall is the primary the
+        # hedge must beat.
+        hot = regions[:8]
+        run_clients(slow[1], "/query/reads", 4, len(hot), seed=7,
+                    region_pool=hot, pool_weights=[1.0] * len(hot))
+        time.sleep(0.3)  # next routed query refreshes the digest view
+        launched0 = counter("fleet.hedge.launched").total()
+        won0 = counter("fleet.hedge.won").value(winner="hedge")
+        lats, wall = run_clients(fleet_addr, "/fleet/query/reads", 8,
+                                 24, seed=8, region_pool=hot,
+                                 pool_weights=[1.0] * len(hot))
+        launched = counter("fleet.hedge.launched").total() - launched0
+        won = counter("fleet.hedge.won").value(winner="hedge") - won0
+        hp = pcts(lats, wall)
+        rows["hedge"] = {
+            "launched": int(launched),
+            "won_hedge": int(won),
+            "win_rate": round(won / launched, 3) if launched else 0.0,
+            "hedged_p99_ms": round(hp["p99_ms"], 3),
+        }
+        fleet_mod.stop_fleet()
+    finally:
+        fleet_mod.stop_fleet()
+        for proc, _addr in procs:
+            proc.kill()
+            proc.wait()
+        if owns_server:
+            stop_introspect_server()
+    return {"15_fleet_serve": rows}
+
+
 def main() -> None:
     # DISQ_TPU_POSTMORTEM_DIR arms the flight recorder for the whole
     # bench: any abort writes a postmortem bundle there, and
@@ -1281,6 +1550,7 @@ def main() -> None:
     configs.update(resident_decode_config(path))
     configs.update(device_write_config(path, tmp))
     configs.update(serve_latency_config(path, tmp))
+    configs.update(fleet_serve_config(path, tmp))
     configs.update(mesh_pipeline_config(path))
 
     # Telemetry snapshot accumulated across every config above

@@ -27,6 +27,7 @@ __version__ = "0.1.0"
 
 from disq_tpu.api import (  # noqa: F401
     ReadsStorage,
+    FleetHandle,
     ServeHandle,
     VariantsStorage,
     ReadsDataset,
@@ -43,6 +44,7 @@ from disq_tpu.api import (  # noqa: F401
     TabixIndexWriteOption,
     StageManifestWriteOption,
     serve,
+    serve_fleet,
 )
 from disq_tpu.runtime import (  # noqa: F401
     BreakerOpenError,

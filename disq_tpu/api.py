@@ -908,3 +908,92 @@ def serve(datasets: dict = None, *, port: int = 0, options=None,
     for name, path in (datasets or {}).items():
         handle.register(name, path)
     return handle
+
+
+class FleetHandle:
+    """Handle on the fleet routing tier started by :func:`serve_fleet`.
+
+    ``address`` is the ``host:port`` of the HTTP plane now answering
+    ``POST /fleet/query/reads|variants|stats``, ``POST /fleet/register``
+    and ``GET /fleet/stats``. ``close()`` tears the router down (and
+    the HTTP server, when :func:`serve_fleet` started it)."""
+
+    def __init__(self, address: str, router, owns_server: bool) -> None:
+        self.address = address
+        self.router = router
+        self._owns_server = owns_server
+
+    def register(self, name: str, path: str, kind: str = None) -> dict:
+        """Fan a dataset registration out to every live replica (each
+        bumps the dataset epoch and drops stale cache entries)."""
+        status, doc = self.router.register(name, path, kind)
+        if status != 200:
+            raise RuntimeError(doc.get("error", f"HTTP {status}"))
+        return doc
+
+    def query(self, endpoint: str, doc: dict) -> tuple:
+        """Route one query (``endpoint`` in 'reads' | 'variants' |
+        'stats') -> ``(status, body)``."""
+        return self.router.query(f"/query/{endpoint}", doc)
+
+    def stats(self) -> dict:
+        return self.router.stats()
+
+    def close(self) -> None:
+        from disq_tpu.runtime import fleet as fleet_mod
+        from disq_tpu.runtime.introspect import stop_introspect_server
+
+        fleet_mod.stop_fleet()
+        if self._owns_server:
+            stop_introspect_server()
+
+    def __enter__(self) -> "FleetHandle":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+_FLEET_UNSET = object()  # None is meaningful (= hedging off)
+
+
+def serve_fleet(replicas, *, port: int = 0, datasets: dict = None,
+                policy: str = "locality",
+                hedge_quantile: float = _FLEET_UNSET,
+                hedge_min_s: float = None,
+                tenant_slots: int = None, tenant_queue: int = None,
+                refresh_s: float = None,
+                probe_s: float = None) -> FleetHandle:
+    """Start the fleet routing tier (``runtime/fleet.py``) over
+    ``replicas`` (a list of ``host:port`` serving endpoints) and
+    return a :class:`FleetHandle`.
+
+    Queries sent to ``/fleet/query/*`` are forwarded to the replica
+    whose hot-block cache already holds the query's blocks (digest
+    overlap scoring off each replica's ``/serve/cachemap``), hedged to
+    the runner-up past the rolling latency quantile
+    (``hedge_quantile``; None disables hedging), and admitted against
+    the fleet-wide aggregate of per-replica tenant capacity."""
+    from disq_tpu.runtime import fleet as fleet_mod
+    from disq_tpu.runtime.introspect import introspect_address
+
+    kwargs = {"policy": policy}
+    if hedge_quantile is not _FLEET_UNSET:
+        kwargs["hedge_quantile"] = hedge_quantile
+    if hedge_min_s is not None:
+        kwargs["hedge_min_s"] = hedge_min_s
+    if tenant_slots is not None:
+        kwargs["tenant_slots"] = tenant_slots
+    if tenant_queue is not None:
+        kwargs["tenant_queue"] = tenant_queue
+    if refresh_s is not None:
+        kwargs["refresh_s"] = refresh_s
+    if probe_s is not None:
+        kwargs["probe_s"] = probe_s
+    owns_server = introspect_address() is None
+    address = fleet_mod.start_fleet(list(replicas), port, **kwargs)
+    handle = FleetHandle(address, fleet_mod.fleet_if_running(),
+                         owns_server)
+    for name, path in (datasets or {}).items():
+        handle.register(name, path)
+    return handle

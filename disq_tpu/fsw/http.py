@@ -177,6 +177,22 @@ class HttpFileSystemWrapper(FileSystemWrapper):
             return sorted(idx for (u, idx), v in self._cache.items()
                           if u == url and isinstance(v, bytes))
 
+    def cached_block_ranges(self, path: str) -> List[Tuple[int, int]]:
+        """Coalesced ``(lo, hi)`` byte ranges of the completed blocks
+        this cache holds for ``path`` — the ``(path, coffset range)``
+        form of :meth:`cached_block_indices` that the fleet tier's
+        cache digests key by, with adjacent blocks merged so a warm
+        contiguous region reads as one range."""
+        ranges: List[Tuple[int, int]] = []
+        for idx in self.cached_block_indices(path):
+            lo = idx * self.block_size
+            hi = lo + self.block_size
+            if ranges and ranges[-1][1] == lo:
+                ranges[-1] = (ranges[-1][0], hi)
+            else:
+                ranges.append((lo, hi))
+        return ranges
+
     def _cache_put(self, key, value) -> None:
         # caller holds self._lock
         self._cache[key] = value

@@ -137,6 +137,7 @@ class WorkerState:
         self.progress: Dict[str, Any] = {}
         self.healthz: Dict[str, Any] = {}
         self.slo: Dict[str, Any] = {}
+        self.serve_stats: Dict[str, Any] = {}
 
 
 class ClusterAggregator:
@@ -204,6 +205,12 @@ class ClusterAggregator:
                 slo_raw = self._get(worker.endpoint, "/slo")
             except Exception:  # noqa: BLE001 — optional endpoint
                 slo_raw = b"{}"
+            # /serve/stats likewise: older replicas 404 it and
+            # non-serving workers answer 503 — both scrape clean.
+            try:
+                serve_raw = self._get(worker.endpoint, "/serve/stats")
+            except Exception:  # noqa: BLE001 — optional endpoint
+                serve_raw = b"{}"
         worker.kinds, worker.samples = parse_metrics_text(metrics_raw)
         try:
             worker.slo = json.loads(slo_raw)
@@ -211,6 +218,12 @@ class ClusterAggregator:
                 worker.slo = {}
         except ValueError:
             worker.slo = {}
+        try:
+            worker.serve_stats = json.loads(serve_raw)
+            if not isinstance(worker.serve_stats, dict):
+                worker.serve_stats = {}
+        except ValueError:
+            worker.serve_stats = {}
         try:
             worker.progress = json.loads(progress_raw)
         except ValueError:
@@ -488,6 +501,59 @@ class ClusterAggregator:
             "processes": processes,
         }
 
+    def serve_stats(self, workers: Optional[List[WorkerState]] = None
+                    ) -> Dict[str, Any]:
+        """Fleet-wide serving-plane view: per-tenant admission usage
+        summed across replicas (active/queued add — they are fleet
+        capacity consumption), head-of-line blocking as the max
+        ``oldest_wait_s`` (one stuck replica pages), aggregate
+        slots/queue as the fleet's admission ceiling, per-process docs
+        preserved under ``process=`` keys. Workers whose ``/serve/stats``
+        404d or 503d (older build, serving off) contribute nothing but
+        do not poison the merge — same tolerance as the ``/slo`` view.
+        """
+        if workers is None:
+            workers = self._fresh()
+        tenants: Dict[str, Dict[str, Any]] = {}
+        processes: Dict[str, Any] = {}
+        slots = queue_depth = serving = 0
+        for w in workers:
+            key = str(w.process_id if w.process_id is not None else -1)
+            if not w.ok:
+                processes[key] = {"endpoint": w.endpoint, "ok": False,
+                                  "error": w.error}
+                continue
+            doc = w.serve_stats or {}
+            processes[key] = {"endpoint": w.endpoint, "ok": True,
+                              "serve": doc}
+            adm = doc.get("admission") or {}
+            if not adm:
+                continue
+            serving += 1
+            slots += int(adm.get("slots") or 0)
+            queue_depth += int(adm.get("queue_depth") or 0)
+            for tenant, tdoc in (adm.get("tenants") or {}).items():
+                agg = tenants.setdefault(str(tenant), {
+                    "active": 0, "queued": 0, "oldest_wait_s": 0.0,
+                    "processes": [],
+                })
+                agg["active"] += int(tdoc.get("active") or 0)
+                agg["queued"] += int(tdoc.get("queued") or 0)
+                agg["oldest_wait_s"] = round(
+                    max(agg["oldest_wait_s"],
+                        float(tdoc.get("oldest_wait_s") or 0.0)), 6)
+                agg["processes"].append(key)
+        return {
+            "cluster": True,
+            "serving": serving,
+            "workers_ok": sum(1 for w in workers if w.ok),
+            "workers_total": len(workers),
+            "slots": slots,
+            "queue_depth": queue_depth,
+            "tenants": tenants,
+            "processes": processes,
+        }
+
     # -- fleet debug collection ---------------------------------------------
 
     def _collect_debug(self, path: str,
@@ -614,6 +680,12 @@ class ClusterAggregator:
                     self._send(
                         200,
                         json.dumps(aggregator.slo(workers),
+                                   default=str).encode(),
+                        "application/json")
+                elif path == "/serve/stats":
+                    self._send(
+                        200,
+                        json.dumps(aggregator.serve_stats(workers),
                                    default=str).encode(),
                         "application/json")
                 elif path == "/debug/stacks":

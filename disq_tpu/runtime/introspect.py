@@ -689,33 +689,48 @@ class _Handler(BaseHTTPRequestHandler):
             doc = slo.slo_doc()
             doc["process_id"] = _process_id()
             self._send_json(doc)
-        elif path == "/serve/stats":
+        elif path.startswith("/serve/"):
             # Serving plane (runtime/serve.py): resolved only when a
             # /serve/* request actually arrives, so the serve-off path
-            # never imports or allocates anything here.
+            # never imports or allocates anything here. Query params
+            # flow through (the cachemap's incremental ?since=N).
             from disq_tpu.runtime import serve
 
-            code, body = serve.handle_http("GET", path, {})
+            doc = {
+                key: values[-1]
+                for key, values in urllib.parse.parse_qs(query).items()
+            }
+            code, body = serve.handle_http("GET", path, doc)
+            self._send_json(body, code)
+        elif path.startswith("/fleet/"):
+            # Fleet tier (runtime/fleet.py): same lazy contract — the
+            # fleet-off path never imports the router module.
+            from disq_tpu.runtime import fleet
+
+            code, body = fleet.handle_http("GET", path, {})
             self._send_json(body, code)
         else:
             self._send_json({"error": "unknown path", "endpoints": [
                 "/metrics", "/healthz", "/progress", "/spans", "/slo",
                 "/debug/stacks", "/debug/profile", "/debug/bundle",
-                "/sched/stats", "/serve/stats"]},
+                "/sched/stats", "/serve/stats", "/serve/cachemap",
+                "/fleet/stats"]},
                 404)
 
     def do_POST(self) -> None:  # noqa: N802 — http.server API
         """The mutating endpoints: the scheduler plane
-        (``/sched/join|lease|done|steal`` — runtime/scheduler.py) and
-        the serving plane (``/query/reads|variants|stats``,
-        ``/serve/register`` — runtime/serve.py). Everything else is
-        GET-only. Both planes are resolved lazily per request so the
-        disabled paths import and allocate nothing."""
+        (``/sched/join|lease|done|steal`` — runtime/scheduler.py), the
+        serving plane (``/query/reads|variants|stats``,
+        ``/serve/register`` — runtime/serve.py) and the fleet tier
+        (``/fleet/query/*``, ``/fleet/register`` — runtime/fleet.py).
+        Everything else is GET-only. Each plane is resolved lazily per
+        request so the disabled paths import and allocate nothing."""
         path, _, _query = self.path.partition("?")
-        if not path.startswith(("/sched/", "/query/", "/serve/")):
+        if not path.startswith(("/sched/", "/query/", "/serve/",
+                                "/fleet/")):
             self._send_json(
-                {"error": "POST only serves /sched/*, /query/* and "
-                          "/serve/*"}, 404)
+                {"error": "POST only serves /sched/*, /query/*, "
+                          "/serve/* and /fleet/*"}, 404)
             return
         try:
             length = int(self.headers.get("Content-Length") or 0)
@@ -735,6 +750,10 @@ class _Handler(BaseHTTPRequestHandler):
                 from disq_tpu.runtime import scheduler
 
                 code, body = scheduler.handle_http("POST", path, doc)
+            elif path.startswith("/fleet/"):
+                from disq_tpu.runtime import fleet
+
+                code, body = fleet.handle_http("POST", path, doc)
             else:
                 from disq_tpu.runtime import serve
 

@@ -502,12 +502,19 @@ class HedgeController:
     # -- the hedged call ----------------------------------------------------
 
     def call(self, fn: Callable[[], Any], shard_id: int = -1,
-             deadline: Optional[ShardDeadline] = None) -> Any:
+             deadline: Optional[ShardDeadline] = None,
+             on_outcome: Optional[Callable[[str, bool], None]] = None) -> Any:
         """Run ``fn`` with hedging: if it outlives the rolling-quantile
         threshold, launch a duplicate and take whichever finishes first
         (first *success* wins; if one side fails while the other is in
         flight, the survivor's outcome decides).  With a deadline past
-        its escalation point the duplicate launches immediately."""
+        its escalation point the duplicate launches immediately.
+
+        ``on_outcome(winner, hedged)`` — when given — fires once per
+        resolved call with ``winner`` in ``{"primary", "hedge",
+        "neither"}`` and whether a duplicate was launched, so callers
+        like the fleet router can book their own hedge accounting
+        (``fleet.hedge.*``) without re-deriving the race result."""
         delay = self.threshold()
         if deadline is not None and deadline.should_force_hedge():
             counter("deadline.hedge_forced").inc()
@@ -521,6 +528,8 @@ class HedgeController:
         if primary in done:
             if primary.exception() is None:
                 self.record(time.perf_counter() - t0)
+                if on_outcome is not None:
+                    on_outcome("primary", False)
             return primary.result()
 
         counter("hedge.launched").inc()
@@ -552,10 +561,14 @@ class HedgeController:
             # Booked as winner="neither" so launched == won stays an
             # exact invariant (check_resilience.py asserts it).
             counter("hedge.won").inc(winner="neither")
+            if on_outcome is not None:
+                on_outcome("neither", True)
             raise first_error  # type: ignore[misc]
         loser = secondary if winner is primary else primary
         loser_started = t0 if loser is primary else h0
         counter("hedge.won").inc(winner=futures[winner])
+        if on_outcome is not None:
+            on_outcome(futures[winner], True)
         if winner is primary:
             self.record(time.perf_counter() - t0)
         if not loser.cancel():

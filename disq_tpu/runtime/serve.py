@@ -10,7 +10,9 @@ path measured in p50/p99 latency under concurrency:
 - **Endpoints** ride the existing introspection HTTP plane
   (``runtime/introspect.py``): ``POST /query/reads``,
   ``POST /query/variants``, ``POST /query/stats``,
-  ``GET /serve/stats`` and ``POST /serve/register`` all funnel through
+  ``GET /serve/stats``, ``GET /serve/cachemap`` (the cache-locality
+  digest the fleet router in ``runtime/fleet.py`` consumes) and
+  ``POST /serve/register`` all funnel through
   :func:`handle_http`, resolved lazily by the handler so the serve-off
   path imports and allocates nothing.
 - **Cross-request device batching**: every cache-missing BGZF block a
@@ -50,7 +52,7 @@ import struct
 import threading
 import time
 import zlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from disq_tpu.runtime.flightrec import record_event
@@ -71,6 +73,26 @@ DEFAULT_TENANT_QUEUE = 16
 DEFAULT_INDEX_CACHE_ENTRIES = 16
 
 _BGZF_FOOTER = 8
+
+# Cache-locality digest granularity: one bucket per 64 KiB of
+# compressed-file offset. BGZF blocks are <= 64 KiB, so every cached
+# block lands in one or two buckets — coarse enough that a replica's
+# digest stays a few hundred ints, fine enough that the fleet router's
+# overlap score mirrors the shard scheduler's block-locality signal.
+DIGEST_BUCKET_BITS = 16
+# Bounded op log backing incremental /serve/cachemap refresh; a router
+# whose `since` has scrolled off gets a full map instead.
+DIGEST_LOG_CAP = 4096
+
+
+def digest_buckets(cb: int, ce: int) -> Tuple[int, ...]:
+    """Digest buckets covered by virtual-offset chunk ``[cb, ce)`` —
+    shared by the cache (put/evict accounting) and the fleet router
+    (scoring a query's chunks against replica digests) so both sides
+    key ``(path, coffset range)`` with identical math."""
+    lo = (cb >> 16) >> DIGEST_BUCKET_BITS
+    hi = max(lo, ((ce >> 16) >> DIGEST_BUCKET_BITS))
+    return tuple(range(lo, hi + 1))
 
 
 class AdmissionShed(Exception):
@@ -198,6 +220,14 @@ class HotBlockCache:
             t: OrderedDict() for t in self.TIERS}
         self._bytes = {t: 0 for t in self.TIERS}
         self._tenant_bytes: Dict[Tuple[str, str], int] = {}
+        # Cache-locality digest: path -> {bucket -> refcount}. The
+        # refcount spans tiers — the digest answers "which file regions
+        # are warm here", not "which tier holds them". Every 0<->1
+        # transition is journaled so /serve/cachemap can answer a
+        # router's incremental `since=` refresh from the log.
+        self._digest: Dict[str, Dict[int, int]] = {}
+        self._digest_seq = 0
+        self._digest_log: deque = deque(maxlen=DIGEST_LOG_CAP)
 
     def get(self, tier: str, path: str, coffset: int,
             tenant: str) -> Optional[Any]:
@@ -215,22 +245,27 @@ class HotBlockCache:
         cap = self._cap[tier]
         if nbytes > cap:
             return
+        buckets = (digest_buckets(*coffset) if isinstance(coffset, tuple)
+                   else (coffset >> DIGEST_BUCKET_BITS,))
         with self._lock:
             lru = self._lru[tier]
             key = (path, coffset)
             if key in lru:
                 lru.move_to_end(key)
                 return
-            lru[key] = (value, nbytes, tenant)
+            lru[key] = (value, nbytes, tenant, buckets)
+            self._digest_add(path, buckets)
             self._bytes[tier] += nbytes
             tk = (tier, tenant)
             self._tenant_bytes[tk] = self._tenant_bytes.get(tk, 0) + nbytes
             while self._bytes[tier] > cap and lru:
-                _, (_, ev_bytes, ev_tenant) = lru.popitem(last=False)
+                ev_key, (_, ev_bytes, ev_tenant, ev_buckets) = lru.popitem(
+                    last=False)
                 self._bytes[tier] -= ev_bytes
                 ek = (tier, ev_tenant)
                 self._tenant_bytes[ek] = max(
                     0, self._tenant_bytes.get(ek, 0) - ev_bytes)
+                self._digest_del(ev_key[0], ev_buckets)
                 counter("serve.cache.evictions").inc(tier=tier)
                 record_event("serve_cache_evict", tier=tier,
                              tenant=ev_tenant, nbytes=ev_bytes)
@@ -242,6 +277,86 @@ class HotBlockCache:
                 self._lru[t].clear()
                 self._bytes[t] = 0
             self._tenant_bytes.clear()
+            # digest goes cold with the cache; bump seq with the log
+            # emptied so any router's `since` falls back to a full map
+            self._digest.clear()
+            self._digest_seq += 1
+            self._digest_log.clear()
+
+    # -- cache-locality digest (fleet routing signal) ----------------------
+
+    def _digest_add(self, path: str, buckets: Tuple[int, ...]) -> None:
+        refs = self._digest.setdefault(path, {})
+        for b in buckets:
+            n = refs.get(b, 0)
+            refs[b] = n + 1
+            if n == 0:
+                self._digest_seq += 1
+                self._digest_log.append((self._digest_seq, "add", path, b))
+
+    def _digest_del(self, path: str, buckets: Tuple[int, ...]) -> None:
+        refs = self._digest.get(path)
+        if refs is None:
+            return
+        for b in buckets:
+            n = refs.get(b, 0)
+            if n <= 1:
+                refs.pop(b, None)
+                self._digest_seq += 1
+                self._digest_log.append((self._digest_seq, "del", path, b))
+            else:
+                refs[b] = n - 1
+        if not refs:
+            self._digest.pop(path, None)
+
+    def invalidate_path(self, path: str) -> int:
+        """Drop every cached entry of ``path`` across all tiers — the
+        cache side of dataset-epoch invalidation: a re-register fans
+        out here so replicas shed stale ``(path, coffset)`` entries."""
+        dropped = 0
+        with self._lock:
+            for tier in self.TIERS:
+                lru = self._lru[tier]
+                stale = [k for k in lru if k[0] == path]
+                for k in stale:
+                    _, ev_bytes, ev_tenant, ev_buckets = lru.pop(k)
+                    self._bytes[tier] -= ev_bytes
+                    ek = (tier, ev_tenant)
+                    self._tenant_bytes[ek] = max(
+                        0, self._tenant_bytes.get(ek, 0) - ev_bytes)
+                    self._digest_del(path, ev_buckets)
+                if stale:
+                    counter("serve.cache.invalidations").inc(
+                        len(stale), tier=tier)
+                    gauge("serve.cache.bytes").observe(
+                        self._bytes[tier], tier=tier)
+                dropped += len(stale)
+        if dropped:
+            record_event("serve_cache_invalidate", path=path,
+                         entries=dropped)
+        return dropped
+
+    def cachemap(self, since: Optional[int] = None) -> Dict[str, Any]:
+        """Compact digest of which ``(path, 64 KiB bucket)`` regions
+        are warm in any tier. With ``since`` set to a previously
+        returned ``seq``, answers the incremental delta while the
+        bounded op log still covers it; otherwise the full map."""
+        with self._lock:
+            doc: Dict[str, Any] = {"seq": self._digest_seq,
+                                   "bucket_bits": DIGEST_BUCKET_BITS}
+            if since is not None and 0 <= since <= self._digest_seq:
+                if since == self._digest_seq:
+                    doc["delta"] = []
+                    return doc
+                log = self._digest_log
+                if log and log[0][0] <= since + 1:
+                    doc["delta"] = [[op, path, bucket]
+                                    for seq, op, path, bucket in log
+                                    if seq > since]
+                    return doc
+            doc["paths"] = {p: sorted(refs)
+                            for p, refs in self._digest.items() if refs}
+            return doc
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -376,6 +491,9 @@ class ServeDaemon:
         else:
             self._hedge = None
         self._datasets: Dict[str, _Dataset] = {}
+        # resolved path -> dataset epoch; bumped on every re-register
+        # so the fleet tier can invalidate stale digests and caches
+        self._epochs: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # -- registry ----------------------------------------------------------
@@ -392,9 +510,18 @@ class ServeDaemon:
             raise FileNotFoundError(path)
         ds = _Dataset(name, fs_path, kind, fs)
         with self._lock:
+            epoch = self._epochs.get(fs_path, 0) + 1
+            self._epochs[fs_path] = epoch
             self._datasets[name] = ds
             gauge("serve.datasets").observe(len(self._datasets))
-        return {"name": name, "path": path, "kind": kind}
+        if epoch > 1:
+            # re-register: the file may have been rewritten under the
+            # same path — shed every cached (path, coffset) entry and
+            # let /serve/cachemap's epoch map tell routers to do the same
+            dropped = self.cache.invalidate_path(fs_path)
+            record_event("serve_register_epoch", name=name,
+                         path=fs_path, epoch=epoch, dropped=dropped)
+        return {"name": name, "path": path, "kind": kind, "epoch": epoch}
 
     def _dataset(self, doc: Dict[str, Any], kind: str) -> _Dataset:
         name = doc.get("dataset")
@@ -798,10 +925,26 @@ class ServeDaemon:
         "/query/stats": "_q_stats",
     }
 
+    def cachemap(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """``GET /serve/cachemap[?since=N]`` — the replica's advertised
+        cache digest plus its dataset epochs, consumed by the fleet
+        router's incremental refresh."""
+        since = doc.get("since")
+        try:
+            since = int(since) if since is not None else None
+        except (TypeError, ValueError):
+            since = None
+        out = self.cache.cachemap(since)
+        with self._lock:
+            out["epochs"] = dict(self._epochs)
+        return out
+
     def handle(self, method: str, path: str,
                doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         if method == "GET" and path == "/serve/stats":
             return 200, self.stats()
+        if method == "GET" and path == "/serve/cachemap":
+            return 200, self.cachemap(doc)
         if method != "POST":
             return 405, {"error": f"{path} expects POST"}
         if path == "/serve/register":

@@ -1200,6 +1200,211 @@ def serve_leg(path, tmp) -> str:
             PosixFileSystemWrapper(), [], seed=0))
 
 
+# Replica subprocess for the fleet leg: one real serving daemon in its
+# own interpreter, registered at startup. Prints its address then holds
+# on stdin (the leg SIGKILLs one of these mid-storm).
+_FLEET_REPLICA_CODE = r"""
+import json, os, sys
+cfg = json.loads(sys.argv[1])
+sys.path.insert(0, cfg["repo"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from disq_tpu.runtime import serve as serve_mod
+addr = serve_mod.start_serve(port=0, tenant_slots=8, tenant_queue=32)
+serve_mod.serve_if_running().register("soak", cfg["bam"])
+print("ADDR", addr, flush=True)
+sys.stdin.readline()
+"""
+
+
+def fleet_leg(path, tmp) -> str:
+    """SIGKILL one replica mid-storm behind the fleet router
+    (runtime/fleet.py): two serving subprocesses answer region queries
+    through the in-process routing tier (locality + hedging armed)
+    while four tenant threads storm it. Contract: a hedged pre-storm
+    request stitches into ONE trace_report waterfall spanning the
+    router and both replicas; the kill is detected on the query path
+    (``fleet.replica_lost`` in the flight recorder, no liveness
+    thread); every storm response — before, during and after the kill
+    — answers 200 with a digest identical to the single-replica truth;
+    and the router's stats show one live replica at the end."""
+    import json
+    import subprocess
+    import threading as _threading
+    import urllib.request
+
+    from disq_tpu import BaiWriteOption, ReadsStorage
+    from disq_tpu.runtime import flightrec
+    from disq_tpu.runtime.introspect import stop_introspect_server
+    from disq_tpu.runtime.tracing import (
+        activate_trace, counter, deactivate_trace, mint_trace)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    indexed = os.path.join(tmp, "fleet-indexed.bam")
+    st = ReadsStorage.make_default().num_shards(4)
+    st.write(st.read(path), indexed, BaiWriteOption.ENABLE, sort=True)
+
+    regions = [("chr1", 1, 5000), ("chr1", 40_000, 60_000),
+               ("chr2", 1, 50_000), ("chrM", 1, 16_569)]
+
+    def query(addr, qpath, region, tenant, timeout=30):
+        contig, start, end = region
+        body = json.dumps({
+            "dataset": "soak", "tenant": tenant, "limit": 0,
+            "intervals": [
+                {"contig": contig, "start": start, "end": end}],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://{addr}{qpath}", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    procs = []
+
+    def spawn_replica():
+        cfg = json.dumps({"repo": repo, "bam": indexed})
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _FLEET_REPLICA_CODE, cfg],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        line = proc.stdout.readline()
+        if not line.startswith("ADDR"):
+            proc.kill()
+            raise RuntimeError(f"fleet replica failed to start: {line!r}")
+        procs.append(proc)
+        return line.split()[1]
+
+    from disq_tpu.runtime import fleet as fleet_mod
+
+    flightrec.enable(os.path.join(tmp, "fleet-flightrec"))
+    try:
+        addrs = [spawn_replica() for _ in range(2)]
+        router_addr = fleet_mod.start_fleet(
+            addrs, policy="locality", hedge_quantile=0.9,
+            hedge_min_s=0.001, refresh_s=0.2, probe_s=600.0)
+        router = fleet_mod.fleet_if_running()
+
+        # Registration fans out (epoch bump on both replicas) and gives
+        # the router its name->path mapping for locality resolution.
+        status, doc = router.register("soak", indexed)
+        if status != 200:
+            return f"fleet: register fan-out answered {status}: {doc}"
+
+        # Single-replica truth: each region straight off replica 0.
+        truth = {}
+        for region in regions:
+            code, body = query(addrs[0], "/query/reads", region, "truth")
+            if code != 200 or "digest" not in body:
+                return (f"fleet: truth query {region} answered {code}: "
+                        f"{body.get('error')}")
+            truth[region] = (body["count"], body["digest"])
+
+        # -- hedged request, stitched across all three processes ----------
+        # Cold regions + a ~1ms hedge floor: the primary's decode
+        # out-runs the timer, so the duplicate launches and both
+        # replicas participate in one trace.
+        trace_id = None
+        for contig, start, end in regions:
+            ctx = mint_trace("t-trace")
+            token = activate_trace(ctx)
+            launched0 = counter("fleet.hedge.launched").total()
+            try:
+                # In-process through the router so the activated
+                # context is current_trace() on the query path; the
+                # router injects X-Disq-Trace-* and both hedge legs'
+                # replicas adopt it.
+                code, body = router.query("/query/reads", {
+                    "dataset": "soak", "tenant": "t-trace", "limit": 0,
+                    "intervals": [{"contig": contig, "start": start,
+                                   "end": end}]})
+            finally:
+                deactivate_trace(token)
+            if code != 200:
+                return (f"fleet: hedged query {region} answered {code}: "
+                        f"{body.get('error')}")
+            if counter("fleet.hedge.launched").total() > launched0:
+                trace_id = ctx.trace_id
+                break
+        if trace_id is None:
+            return "fleet: no hedge launched across any cold region"
+        report = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "trace_report.py"),
+             router_addr, addrs[0], addrs[1], "--request", trace_id],
+            capture_output=True, text=True, timeout=60)
+        if report.returncode != 0:
+            return f"fleet: trace_report failed: {report.stderr[:300]}"
+        stitched = report.stdout
+        if "3 processes" not in stitched.splitlines()[0]:
+            return ("fleet: hedged trace did not stitch router + both "
+                    f"replicas: {stitched.splitlines()[0]}")
+        if "fleet.request.trace" not in stitched \
+                or "serve.request.trace" not in stitched:
+            return ("fleet: stitched waterfall is missing the router "
+                    "or replica root spans")
+
+        # -- the storm: 4 tenants loop the regions, one replica dies ------
+        errors = []
+        done = _threading.Event()
+        count = [0]
+        lock = _threading.Lock()
+
+        def tenant(k):
+            name = f"storm-{k}"
+            for loop in range(6):
+                for region in regions:
+                    code, body = query(router_addr, "/fleet/query/reads",
+                                       region, name)
+                    if code != 200:
+                        errors.append(
+                            f"tenant {name} got {code} for {region}: "
+                            f"{body.get('error')}")
+                        return
+                    got = (body.get("count"), body.get("digest"))
+                    if got != truth[region]:
+                        errors.append(
+                            f"tenant {name} {region} answered {got}, "
+                            f"truth {truth[region]}")
+                        return
+                    with lock:
+                        count[0] += 1
+                        if count[0] >= 24:
+                            done.set()
+
+        threads = [_threading.Thread(target=tenant, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        # SIGKILL the *truth* replica about a third of the way in: the
+        # survivors' answers must still match its pre-storm digests.
+        done.wait(timeout=60)
+        procs[0].kill()
+        procs[0].wait()
+        for t in threads:
+            t.join()
+        if errors:
+            return "fleet: " + "; ".join(errors[:3])
+
+        stats = router.stats()
+        if stats["live"] != 1:
+            return (f"fleet: router sees {stats['live']} live replicas "
+                    "after the kill, expected 1")
+        rec = flightrec.recorder()
+        events = rec.events() if rec is not None else []
+        if not any(e.get("kind") == "fleet.replica_lost" for e in events):
+            return ("fleet: replica SIGKILLed but no fleet.replica_lost "
+                    "event in the flight recorder ring")
+        return ""
+    finally:
+        fleet_mod.stop_fleet()
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+        stop_introspect_server()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--iterations", type=int, default=20)
@@ -1273,6 +1478,15 @@ def main(argv=None) -> int:
                          "and the survivors must finish the same "
                          "epoch's complement exactly once, digest-"
                          "identical to a single-host read")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet-failover leg: two serving "
+                         "replicas behind the locality/hedging router, "
+                         "one SIGKILLed mid-storm; a hedged request "
+                         "must stitch into one trace across all three "
+                         "processes, fleet.replica_lost must land in "
+                         "the flight recorder, and every tenant "
+                         "response must stay digest-identical to the "
+                         "dead replica's pre-storm truth")
     ap.add_argument("--kill", action="store_true",
                     help="run the crash-resume leg: SIGKILL a writer "
                          "subprocess mid-run, resume from its "
@@ -1350,6 +1564,11 @@ def main(argv=None) -> int:
         if args.serve:
             err = serve_leg(path, tmp)
             print(f"[serve] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.fleet:
+            err = fleet_leg(path, tmp)
+            print(f"[fleet] {'ok' if not err else 'FAIL: ' + err}")
             if err:
                 failures.append((args.seed, err))
         print(f"{len(failures)} mismatches in {args.iterations} iterations")

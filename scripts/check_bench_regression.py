@@ -91,6 +91,10 @@ CONFIG_TOLERANCE = {
     # 3 reps: device-queue wobble (as 10/11) plus ICI-collective timing
     # variance from the psum/all_to_all exchange.
     "14_mesh_pipeline": 0.30,
+    # Config 15 measures tail latency through the fleet router across
+    # real serving subprocesses — config 13's percentile wobble plus
+    # OS-scheduler noise from 3 extra interpreters on the same box.
+    "15_fleet_serve": 0.30,
 }
 
 

@@ -72,6 +72,10 @@ ALLOWED_PREFIXES = {
     # Per-tenant SLO layer (runtime/slo.py): multi-window burn-rate
     # gauges, the fast-burn page flag, and evaluator tick counter.
     "slo",
+    # Fleet routing tier (runtime/fleet.py): locality-routing
+    # decisions, cross-replica hedge accounting, fleet-wide admission,
+    # replica liveness gauge and cachemap refresh spans.
+    "fleet",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

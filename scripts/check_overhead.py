@@ -85,7 +85,7 @@ def main() -> int:
         if t.name.startswith(
             ("disq-watchdog", "disq-introspect", "disq-device",
              "disq-hostwork", "disq-profiler", "disq-serve",
-             "disq-slo"))
+             "disq-slo", "disq-fleet", "disq-hedge"))
     ]
     if bad_threads:
         errors.append(f"stray observability threads: {bad_threads}")
@@ -204,6 +204,51 @@ def main() -> int:
         errors.append(
             "handle_http on the serve-off path allocated the daemon — "
             "only start_serve() may create caches/admission state")
+    if "disq_tpu.runtime.fleet" in sys.modules:
+        errors.append(
+            "exercising the serve plane imported runtime.fleet — the "
+            "/serve/* path must stay byte-identical to the pre-fleet "
+            "serving plane and never consult the router module")
+
+    # -- 1b5. fleet tier: off ⇒ no router, thread, socket or fleet state ----
+    # Capture the serve-off answers first: importing/exercising the
+    # fleet module must leave /serve/* byte-identical.
+    import json as _json
+
+    serve_before = [
+        serve_plane.handle_http("POST", "/query/reads", {}),
+        serve_plane.handle_http("GET", "/serve/stats", {}),
+        serve_plane.handle_http("GET", "/serve/cachemap", {}),
+    ]
+    from disq_tpu.runtime import fleet as fleet_plane
+
+    if fleet_plane.fleet_if_running() is not None:
+        errors.append(
+            "a fleet router exists with no start_fleet() call — the "
+            "fleet-off path must hold no replica or digest state")
+    code, _body = fleet_plane.handle_http("POST", "/fleet/query/reads", {})
+    if code != 503:
+        errors.append(
+            f"fleet.handle_http answered {code} with no router running "
+            "— the fleet-off path must 503 without routing")
+    if fleet_plane.fleet_if_running() is not None:
+        errors.append(
+            "handle_http on the fleet-off path allocated the router — "
+            "only start_fleet() may create clients/digest state")
+    if any(t.name.startswith(("disq-fleet", "disq-hedge"))
+           for t in threading.enumerate()):
+        errors.append(
+            "stray fleet/hedge thread on the disabled path — the "
+            "router owns no threads and the hedge pool is lazy")
+    serve_after = [
+        serve_plane.handle_http("POST", "/query/reads", {}),
+        serve_plane.handle_http("GET", "/serve/stats", {}),
+        serve_plane.handle_http("GET", "/serve/cachemap", {}),
+    ]
+    if _json.dumps(serve_before) != _json.dumps(serve_after):
+        errors.append(
+            "/serve/* answers changed after exercising the fleet-off "
+            "path — fleet must not perturb the serving plane")
 
     # -- 1c. resident decode: disabled ⇒ no ColumnarBatch device builds ------
     from disq_tpu.runtime import columnar

@@ -12,6 +12,16 @@ section for the endpoint table and QoS semantics)::
     curl -s -XPOST localhost:8765/query/reads -d '{
         "dataset": "wgs", "tenant": "alice",
         "intervals": [{"contig": "chr1", "start": 1, "end": 100000}]}'
+
+With ``--fleet`` the process runs the *routing tier* instead of a
+replica: queries POSTed to ``/fleet/query/*`` are forwarded to the
+replica whose hot-block cache already holds their blocks, hedged to
+the runner-up on tail latency (see the README "Fleet serving"
+section)::
+
+    python scripts/serve.py --port 8800 \
+        --fleet 127.0.0.1:8765,127.0.0.1:8766 \
+        --dataset wgs=/data/sample.bam
 """
 
 from __future__ import annotations
@@ -46,6 +56,16 @@ def main(argv=None) -> int:
                     help="decoded hot-block tier budget")
     ap.add_argument("--parsed-cache-mb", type=int, default=None,
                     help="parsed chunk-batch tier budget")
+    ap.add_argument("--fleet", default=None, metavar="HOST:PORT,...",
+                    help="run the fleet routing tier over these "
+                         "replica endpoints instead of a replica")
+    ap.add_argument("--fleet-policy", default="locality",
+                    choices=("locality", "random", "roundrobin"),
+                    help="replica selection policy (fleet mode)")
+    ap.add_argument("--fleet-hedge-quantile", type=float, default=None,
+                    help="hedge past this rolling latency quantile "
+                         "(fleet mode; default %s, 0 disables)"
+                         % "0.95")
     args = ap.parse_args(argv)
 
     datasets = {}
@@ -55,17 +75,36 @@ def main(argv=None) -> int:
             ap.error(f"--dataset wants NAME=PATH, got {spec!r}")
         datasets[name] = path
 
-    from disq_tpu.api import serve
+    if args.fleet:
+        from disq_tpu.api import serve_fleet
 
-    handle = serve(
-        datasets, port=args.port,
-        tenant_slots=args.tenant_slots, tenant_queue=args.tenant_queue,
-        compressed_cache_mb=args.compressed_cache_mb,
-        decoded_cache_mb=args.decoded_cache_mb,
-        parsed_cache_mb=args.parsed_cache_mb)
-    names = ", ".join(datasets) or "none (POST /serve/register)"
-    print(f"serving on http://{handle.address}  (datasets: {names})",
-          flush=True)
+        replicas = [e.strip() for e in args.fleet.split(",") if e.strip()]
+        quantile = args.fleet_hedge_quantile
+        kwargs = {}
+        if quantile is not None:
+            kwargs["hedge_quantile"] = quantile if quantile > 0 else None
+        handle = serve_fleet(
+            replicas, port=args.port, datasets=datasets,
+            policy=args.fleet_policy,
+            tenant_slots=args.tenant_slots,
+            tenant_queue=args.tenant_queue, **kwargs)
+        names = ", ".join(datasets) or "none (POST /fleet/register)"
+        print(f"fleet router on http://{handle.address} -> "
+              f"{len(replicas)} replicas  (datasets: {names})",
+              flush=True)
+    else:
+        from disq_tpu.api import serve
+
+        handle = serve(
+            datasets, port=args.port,
+            tenant_slots=args.tenant_slots,
+            tenant_queue=args.tenant_queue,
+            compressed_cache_mb=args.compressed_cache_mb,
+            decoded_cache_mb=args.decoded_cache_mb,
+            parsed_cache_mb=args.parsed_cache_mb)
+        names = ", ".join(datasets) or "none (POST /serve/register)"
+        print(f"serving on http://{handle.address}  (datasets: {names})",
+              flush=True)
 
     stop = []
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))

@@ -424,3 +424,52 @@ class TestServeFleetViews:
         dead_doc = [p for p in doc["processes"].values()
                     if not p["ok"]]
         assert dead_doc and "ConnectionRefused" in dead_doc[0]["error"]
+
+    def test_serve_stats_merges_admission_across_replicas(self):
+        """Fleet admission view: per-tenant active/queued SUM across
+        replicas (they consume fleet capacity additively), head-of-line
+        blocking is the MAX oldest_wait_s (one stuck replica pages),
+        and slots/queue_depth sum into the fleet ceiling."""
+        w0, w1 = _serve_worker(0, 1, 0), _serve_worker(1, 1, 0)
+        w0.serve_stats = {"admission": {
+            "slots": 4, "queue_depth": 8,
+            "tenants": {"t0": {"active": 2, "queued": 1,
+                               "oldest_wait_s": 0.5}}}}
+        w1.serve_stats = {"admission": {
+            "slots": 4, "queue_depth": 8,
+            "tenants": {"t0": {"active": 1, "queued": 0,
+                               "oldest_wait_s": 1.25},
+                        "t1": {"active": 1, "queued": 0,
+                               "oldest_wait_s": 0.0}}}}
+        doc = self._agg().serve_stats([w0, w1])
+        assert doc["cluster"] is True
+        assert doc["serving"] == 2
+        assert doc["slots"] == 8 and doc["queue_depth"] == 16
+        t0 = doc["tenants"]["t0"]
+        assert t0["active"] == 3 and t0["queued"] == 1
+        assert t0["oldest_wait_s"] == 1.25
+        assert t0["processes"] == ["0", "1"]
+        assert doc["tenants"]["t1"]["processes"] == ["1"]
+        assert doc["processes"]["0"]["serve"] == w0.serve_stats
+
+    def test_serve_stats_tolerates_dead_and_serving_off(self):
+        """A dead worker and a worker whose serving plane is off (no
+        admission doc) contribute nothing but do not poison the merge."""
+        w0 = _serve_worker(0, 1, 0)
+        w0.serve_stats = {"admission": {
+            "slots": 2, "queue_depth": 4,
+            "tenants": {"t0": {"active": 1, "queued": 0,
+                               "oldest_wait_s": 0.0}}}}
+        off = _serve_worker(1, 1, 0)
+        off.serve_stats = {}
+        dead = WorkerState("s2:1")
+        dead.ok = False
+        dead.error = "ConnectionRefusedError: x"
+        doc = self._agg().serve_stats([w0, off, dead])
+        assert doc["serving"] == 1
+        assert doc["workers_ok"] == 2 and doc["workers_total"] == 3
+        assert doc["slots"] == 2 and doc["queue_depth"] == 4
+        assert list(doc["tenants"]) == ["t0"]
+        dead_doc = [p for p in doc["processes"].values()
+                    if not p["ok"]]
+        assert dead_doc and "ConnectionRefused" in dead_doc[0]["error"]

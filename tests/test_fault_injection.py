@@ -300,7 +300,12 @@ def test_chaos_soak_smoke(executor_workers):
     (tenant storm
     against the serving plane under transient read faults: good
     tenants succeed with truthful counts, the abusive tenant sheds
-    with 429s and serve.admission{result=shed} is booked)."""
+    with 429s and serve.admission{result=shed} is booked), and --fleet
+    (two serving replicas behind the locality/hedging router, one
+    SIGKILLed mid-storm: a hedged request stitches into one trace
+    across router + both replicas, fleet.replica_lost lands in the
+    flight recorder, and every response stays digest-identical to the
+    dead replica's pre-storm truth)."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
@@ -309,7 +314,7 @@ def test_chaos_soak_smoke(executor_workers):
          "--seed", "7", "--executor-workers", str(executor_workers),
          "--writer-workers", str(executor_workers),
          "--hedge", "--breaker", "--resident", "--device-write",
-         "--steal", "--kill", "--coord-kill", "--serve"]
+         "--steal", "--kill", "--coord-kill", "--serve", "--fleet"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},

@@ -1,0 +1,376 @@
+"""Fleet routing tier (``runtime/fleet.py``) and the serve-side cache
+digest it routes on (``runtime/serve.py``): digest bookkeeping through
+put/evict/invalidate, incremental ``/serve/cachemap`` refresh, locality
+ranking and rendezvous stickiness on an injected clock and scripted
+replica clients, cross-replica hedge accounting, fleet-wide admission,
+epoch invalidation of the router's digest view, and replica-loss
+rerouting. Integration (real subprocess replicas, SIGKILL mid-storm)
+lives in the slow-marked chaos soak (``scripts/chaos_soak.py --fleet``)
+and bench config 15."""
+
+import threading
+
+import pytest
+
+from disq_tpu.runtime.fleet import FleetRouter, ReplicaError, handle_http
+from disq_tpu.runtime.serve import (
+    DIGEST_BUCKET_BITS,
+    HotBlockCache,
+    digest_buckets,
+)
+from disq_tpu.runtime.tracing import (
+    activate_trace,
+    counter,
+    deactivate_trace,
+    gauge,
+    mint_trace,
+    reset_telemetry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    reset_telemetry()
+    yield
+    reset_telemetry()
+
+
+# -- serve-side cache digest ------------------------------------------------
+
+
+def _mk_cache(**caps):
+    kw = {"compressed_bytes": 1 << 20, "decoded_bytes": 1 << 20,
+          "parsed_bytes": 1 << 20}
+    kw.update(caps)
+    return HotBlockCache(**kw)
+
+
+class TestCacheDigest:
+    def test_digest_buckets_share_the_scheduler_math(self):
+        """Virtual-offset chunks and cached coffsets must land in the
+        same buckets, or router overlap scores compare unlike units."""
+        cb = 65_536 << 16          # coffset 64 KiB -> bucket 1
+        ce = 200_000 << 16         # coffset ~195 KiB -> bucket 3
+        assert digest_buckets(cb, ce) == (1, 2, 3)
+        # an intra-block chunk (ce's coffset == cb's) is one bucket
+        assert digest_buckets(cb, cb | 0x1FF) == (1,)
+        # the int-coffset form the cache books on put()
+        assert (65_536 >> DIGEST_BUCKET_BITS) == 1
+
+    def test_put_journals_digest_and_cachemap_reports_it(self):
+        cache = _mk_cache()
+        cache.put("compressed", "p.bam", 0, b"x", 8, "t")
+        cache.put("decoded", "p.bam", 70_000, b"y", 8, "t")
+        doc = cache.cachemap()
+        assert doc["bucket_bits"] == DIGEST_BUCKET_BITS
+        assert doc["paths"] == {"p.bam": [0, 1]}
+        assert doc["seq"] == 2
+
+    def test_cachemap_incremental_delta(self):
+        cache = _mk_cache()
+        cache.put("compressed", "p.bam", 0, b"x", 8, "t")
+        s0 = cache.cachemap()["seq"]
+        assert cache.cachemap(since=s0)["delta"] == []
+        cache.put("compressed", "p.bam", 70_000, b"y", 8, "t")
+        delta = cache.cachemap(since=s0)
+        assert delta["delta"] == [["add", "p.bam", 1]]
+        # a refcounted re-add of a warm bucket journals nothing
+        cache.put("parsed", "p.bam", 70_001, b"z", 8, "t")
+        assert cache.cachemap(since=delta["seq"])["delta"] == []
+
+    def test_eviction_journals_digest_del(self):
+        cache = _mk_cache(compressed_bytes=16)
+        cache.put("compressed", "p.bam", 0, b"x", 10, "t")
+        s0 = cache.cachemap()["seq"]
+        # second put exceeds the 16-byte tier cap -> first is evicted
+        cache.put("compressed", "p.bam", 70_000, b"y", 10, "t")
+        delta = cache.cachemap(since=s0)["delta"]
+        assert ["add", "p.bam", 1] in delta
+        assert ["del", "p.bam", 0] in delta
+        assert cache.cachemap()["paths"] == {"p.bam": [1]}
+
+    def test_invalidate_path_drops_only_that_path(self):
+        cache = _mk_cache()
+        cache.put("compressed", "a.bam", 0, b"x", 8, "t")
+        cache.put("parsed", "a.bam", 70_000, b"y", 8, "t")
+        cache.put("compressed", "b.bam", 0, b"z", 8, "t")
+        dropped = cache.invalidate_path("a.bam")
+        assert dropped == 2
+        assert cache.cachemap()["paths"] == {"b.bam": [0]}
+        assert cache.stats()["compressed"]["bytes"] == 8
+        assert counter("serve.cache.invalidations").value(
+            tier="compressed") == 1
+
+    def test_clear_scrolls_routers_to_full_map(self):
+        cache = _mk_cache()
+        cache.put("compressed", "p.bam", 0, b"x", 8, "t")
+        s0 = cache.cachemap()["seq"]
+        cache.clear()
+        # seq bumped with the log emptied: an incremental `since`
+        # falls back to the (now empty) full map, never a stale delta
+        doc = cache.cachemap(since=s0)
+        assert "delta" not in doc
+        assert doc["paths"] == {}
+
+
+# -- router units on scripted clients + injected clock ----------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class _FakeClient:
+    """Scripted replica: cachemap/stats/healthz/register/query, a
+    ``fail`` switch for transport death, a ``block`` event to wedge
+    query responses (hedge tests)."""
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self.cachemap = {"seq": 0, "bucket_bits": DIGEST_BUCKET_BITS,
+                         "paths": {}, "epochs": {}}
+        self.stats_doc = {"admission": {"slots": 4, "queue_depth": 8,
+                                        "tenants": {}}}
+        self.register_epoch = 1
+        self.fail = False
+        self.block = None
+        self.queries = []
+        self.registers = []
+
+    def request(self, method, path, doc=None, headers=None):
+        if self.fail:
+            raise ReplicaError(self.endpoint,
+                               ConnectionRefusedError("down"))
+        if path.startswith("/serve/cachemap"):
+            return 200, dict(self.cachemap)
+        if path == "/serve/stats":
+            return 200, self.stats_doc
+        if path == "/healthz":
+            return 200, {"status": "ok"}
+        if path == "/serve/register":
+            self.registers.append(doc)
+            return 200, {"name": doc["name"], "kind": "reads",
+                         "epoch": self.register_epoch}
+        if path.startswith("/query/"):
+            if self.block is not None:
+                self.block.wait(timeout=10)
+            self.queries.append((doc, dict(headers or {})))
+            return 200, {"count": 1, "replica": self.endpoint}
+        return 404, {"error": path}
+
+    def close(self):
+        pass
+
+
+def _mk_router(n=2, **kw):
+    clients = {}
+
+    def factory(ep):
+        clients[ep] = _FakeClient(ep)
+        return clients[ep]
+
+    clock = _FakeClock()
+    kw.setdefault("hedge_quantile", None)
+    router = FleetRouter([f"r{i}:1" for i in range(n)],
+                         client_factory=factory, clock=clock, **kw)
+    return router, clients, clock
+
+
+class TestFleetRouter:
+    def test_locality_routes_to_the_digest_holder(self):
+        router, clients, _clock = _mk_router()
+        try:
+            clients["r1:1"].cachemap = {
+                "seq": 3, "bucket_bits": DIGEST_BUCKET_BITS,
+                "paths": {"p.bam": [5, 6]}, "epochs": {}}
+            router._resolve = lambda doc: ("p.bam", [5])
+            status, body = router.query("/query/reads", {"dataset": "p.bam"})
+            assert status == 200
+            assert body["replica"] == "r1:1"
+            assert counter("fleet.route").value(result="hit") == 1
+            assert counter("fleet.routed").value(
+                endpoint="reads", replica="r1:1") == 1
+        finally:
+            router.close()
+
+    def test_cold_rendezvous_is_sticky_per_region(self):
+        """No digest anywhere: repeats of one region go to ONE replica
+        (and become warm there), while distinct regions spread across
+        the fleet — the tie-break key carries the region, not just the
+        dataset path."""
+        router, clients, _clock = _mk_router()
+        try:
+            region = {}
+            router._resolve = lambda doc: ("p.bam", [region["b"]])
+            region["b"] = 7
+            for _ in range(3):
+                status, body = router.query("/query/reads", {})
+                assert status == 200
+            first = {body["replica"]}
+            assert {c.endpoint for c in clients.values()
+                    if c.queries} == first
+            targets = set()
+            for b in range(32):
+                region["b"] = b
+                _status, body = router.query("/query/reads", {})
+                targets.add(body["replica"])
+            assert targets == {"r0:1", "r1:1"}
+            assert counter("fleet.route").value(result="miss") == 35
+        finally:
+            router.close()
+
+    def test_hedge_books_launch_and_win(self):
+        """A wedged primary races a duplicate on the runner-up; first
+        response wins and both sides of the outcome are booked."""
+        router, clients, _clock = _mk_router(
+            hedge_quantile=0.5, hedge_min_s=0.005)
+        wedge = threading.Event()
+        try:
+            # digest overlap ranks r0 first; r0 then wedges on query
+            clients["r0:1"].cachemap = {
+                "seq": 1, "bucket_bits": DIGEST_BUCKET_BITS,
+                "paths": {"p.bam": [1]}, "epochs": {}}
+            clients["r0:1"].block = wedge
+            router._resolve = lambda doc: ("p.bam", [1])
+            status, body = router.query("/query/reads", {"tenant": "t"})
+            assert status == 200
+            assert body["replica"] == "r1:1"
+            assert counter("fleet.hedge.launched").total() == 1
+            assert counter("fleet.hedge.won").value(winner="hedge") == 1
+        finally:
+            wedge.set()
+            router.close()
+
+    def test_trace_headers_ride_the_dispatch(self):
+        router, clients, _clock = _mk_router()
+        ctx = mint_trace("t")
+        token = activate_trace(ctx)
+        try:
+            router._resolve = lambda doc: ("p.bam", None)
+            status, _body = router.query("/query/reads", {"tenant": "t"})
+            assert status == 200
+            (_doc, headers), = [q for c in clients.values()
+                                for q in c.queries]
+            assert headers.get("X-Disq-Trace-Id") == ctx.trace_id
+        finally:
+            deactivate_trace(token)
+            router.close()
+
+    def test_fleet_admission_sheds_an_aggregate_hog(self):
+        """A tenant whose summed active+queued across replica stats
+        saturates the fleet's aggregate capacity gets 429 at the
+        router, even though each replica alone looks tolerable."""
+        router, clients, _clock = _mk_router()
+        try:
+            for c in clients.values():
+                c.stats_doc = {"admission": {
+                    "slots": 1, "queue_depth": 0,
+                    "tenants": {"hog": {"active": 2, "queued": 0}}}}
+            router._resolve = lambda doc: ("p.bam", None)
+            status, body = router.query("/query/reads", {"tenant": "hog"})
+            assert status == 429
+            assert "hog" in body["error"]
+            assert counter("fleet.admission").value(
+                result="shed", tenant="hog") == 1
+            # other tenants still clear the same fleet
+            status, _body = router.query("/query/reads", {"tenant": "ok"})
+            assert status == 200
+        finally:
+            router.close()
+
+    def test_replica_loss_reroutes_and_records(self, tmp_path):
+        from disq_tpu.runtime import flightrec
+
+        flightrec.enable(str(tmp_path))
+        router, clients, clock = _mk_router(probe_s=2.0)
+        try:
+            clients["r0:1"].fail = True
+            router._resolve = lambda doc: ("p.bam", None)
+            status, body = router.query("/query/reads", {})
+            assert status == 200
+            assert body["replica"] == "r1:1"
+            assert router.stats()["live"] == 1
+            assert gauge("fleet.replicas").state()["last"] == 1
+            events = flightrec.recorder().events()
+            assert any(e.get("kind") == "fleet.replica_lost"
+                       and e.get("endpoint") == "r0:1" for e in events)
+            # replica returns; the lazy probe restores it
+            clients["r0:1"].fail = False
+            clock.now += 10.0
+            status, _body = router.query("/query/reads", {})
+            assert status == 200
+            assert router.stats()["live"] == 2
+            assert any(e.get("kind") == "fleet.replica_restored"
+                       for e in flightrec.recorder().events())
+        finally:
+            router.close()
+
+    def test_epoch_bump_drops_router_digest_view(self):
+        router, _clients, _clock = _mk_router()
+        try:
+            r = router._replicas[0]
+            router._apply_cachemap(r, {
+                "seq": 4, "paths": {"p.bam": [1, 2]},
+                "epochs": {"p.bam": 1}})
+            assert r.digest == {"p.bam": {1, 2}}
+            # re-register on the replica: epoch bumps, delta is empty —
+            # the router must still shed its stale warm view
+            router._apply_cachemap(r, {
+                "seq": 5, "delta": [], "epochs": {"p.bam": 2}})
+            assert r.digest == {}
+            assert r.seq == 5
+        finally:
+            router.close()
+
+    def test_register_fans_out_and_resyncs(self, tmp_path):
+        from disq_tpu.fsw.filesystem import resolve_path
+
+        path = tmp_path / "d.bam"
+        path.write_bytes(b"")
+        _fs, fs_path = resolve_path(str(path))
+        router, clients, _clock = _mk_router()
+        try:
+            clients["r1:1"].register_epoch = 3
+            router._replicas[0].digest[fs_path] = {1, 2}
+            status, doc = router.register("ds", str(path))
+            assert status == 200
+            assert doc["epoch"] == 3
+            assert all(len(c.registers) == 1 for c in clients.values())
+            assert fs_path not in router._replicas[0].digest
+            assert router.stats()["datasets"]["ds"]["kind"] == "reads"
+        finally:
+            router.close()
+
+    def test_handle_routes_and_rejects(self):
+        router, _clients, _clock = _mk_router()
+        try:
+            status, doc = router.handle("GET", "/fleet/stats", {})
+            assert status == 200 and doc["live"] == 2
+            status, _doc = router.handle("GET", "/fleet/query/reads", {})
+            assert status == 405
+            status, doc = router.handle("POST", "/fleet/register",
+                                        {"name": "x"})
+            assert status == 400
+            status, doc = router.handle("POST", "/fleet/nope", {})
+            assert status == 404 and "/fleet/query/reads" in doc["endpoints"]
+        finally:
+            router.close()
+
+    def test_fleet_off_answers_503_without_allocating(self):
+        from disq_tpu.runtime import fleet as fleet_mod
+
+        assert fleet_mod.fleet_if_running() is None
+        status, doc = handle_http("POST", "/fleet/query/reads", {})
+        assert status == 503
+        assert "not started" in doc["error"]
+
+    def test_router_rejects_bad_config(self):
+        with pytest.raises(ValueError, match="at least one replica"):
+            FleetRouter([])
+        with pytest.raises(ValueError, match="unknown routing policy"):
+            FleetRouter(["r0:1"], policy="nearest",
+                        client_factory=_FakeClient)

@@ -463,3 +463,15 @@ class TestCacheSizeKnob:
         assert fs.cached_block_indices(url + ".other") == []
         state = REGISTRY.gauge("fsw.http.cache.blocks").state()
         assert state is not None and state["last"] >= 3
+
+    def test_cached_block_ranges_coalesces_adjacent(self, bam_url):
+        """The (path, byte-range) form of the occupancy signal the
+        fleet tier's cache digests key by: adjacent warm blocks merge
+        into one range, gaps split."""
+        url, raw = bam_url
+        fs = HttpFileSystemWrapper(block_size=1024, prefetch=False,
+                                   max_cached_blocks=8)
+        fs.read_range(url, 0, 2048)       # blocks 0, 1
+        fs.read_range(url, 5 * 1024, 10)  # block 5
+        assert fs.cached_block_ranges(url) == [(0, 2048), (5120, 6144)]
+        assert fs.cached_block_ranges(url + ".other") == []
