@@ -898,6 +898,60 @@ print(json.dumps({{"host": os.environ.get("DISQ_TPU_SCHED_HOST"),
 """
 
 
+def operator_suite_config(path: str) -> dict:
+    """Config 16: the chained sam2bam operator pipeline
+    (``runtime/oppipe.py``: filter → sort → markdup → rgstats) on the
+    resident columnar currency against the host-materializing path —
+    real chip only.
+
+    Resident leg = decode stays in HBM and every operator
+    compacts/permutes/reduces the device columns (zero ``ReadBatch``
+    materializations, asserted from the registry, not inferred). Host
+    leg = same operators' numpy paths over host batches — identical
+    stats by construction (tier-1 golden tests), so the row measures
+    pure residency win. ``d2h_bytes`` / ``d2h_avoided_bytes`` come
+    from ``device.*`` registry deltas."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {}
+    from disq_tpu import ReadsStorage
+    from disq_tpu.runtime.tracing import REGISTRY
+
+    d2h = REGISTRY.counter("device.bytes_to_host")
+    avoided = REGISTRY.counter("device.d2h_avoided_bytes")
+    mats = REGISTRY.counter("columnar.batch.materializations")
+    chain = (("filter", "-F 0x900"), "sort", "markdup", "rgstats")
+
+    def run(resident: bool):
+        storage = ReadsStorage.make_default().resident_decode(resident)
+        ds = storage.read(path)
+        out, stats = ds.pipeline(*chain)
+        n = int(out.reads.count)
+        if resident and hasattr(out.reads, "release"):
+            out.reads.release()
+        return n, stats
+
+    out: dict = {}
+    for name, resident in (("host", False), ("resident", True)):
+        n_rec = run(resident)[0]  # warm (compile caches)
+        d0, a0, m0 = d2h.total(), avoided.total(), mats.total()
+        med, times = _timed(lambda: run(resident), 3)
+        out[name] = {
+            "records_per_sec": round(n_rec / med, 1),
+            "spread": _spread(times),
+            "d2h_bytes": int((d2h.total() - d0) / len(times)),
+        }
+        if resident:
+            out[name]["d2h_avoided_bytes"] = int(
+                (avoided.total() - a0) / len(times))
+            out[name]["materializations"] = int(mats.total() - m0)
+    out["resident_vs_host"] = round(
+        out["resident"]["records_per_sec"]
+        / out["host"]["records_per_sec"], 3)
+    return {"16_operator_suite": out}
+
+
 def sched_steal_config(path: str, tmp: str) -> dict:
     """Config 12: the cross-host shard scheduler
     (``runtime/scheduler.py``) under a deliberate straggler — 1/2/4
@@ -1552,6 +1606,7 @@ def main() -> None:
     configs.update(serve_latency_config(path, tmp))
     configs.update(fleet_serve_config(path, tmp))
     configs.update(mesh_pipeline_config(path))
+    configs.update(operator_suite_config(path))
 
     # Telemetry snapshot accumulated across every config above
     # (runtime/tracing.py): phase totals + p50/p99, labeled counters

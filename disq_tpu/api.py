@@ -266,6 +266,35 @@ class ReadsDataset:
             self.reads, [s.length for s in self.header.sequences], window
         )
 
+    def pipeline(self, *ops) -> "Tuple[ReadsDataset, dict]":
+        """Run a resident operator chain (``runtime/oppipe.py``) over
+        this dataset's batch and return ``(dataset, stats)`` — the
+        sam2bam preprocessing shape as one composition on the columnar
+        currency::
+
+            ds2, stats = ds.pipeline(("filter", "-F 0x400 -q 20"),
+                                     "sort", "markdup", "rgstats")
+
+        Each op is an operator instance (``FilterOp`` and friends), a
+        name, or a ``(name, *args)`` tuple. On a resident dataset the
+        whole chain stays device-backed — transforms compact/permute/
+        patch the HBM columns, reductions move only result rows d2h,
+        and no host record is ever materialized; a host dataset runs
+        the same operators' host paths with identical outputs.
+        ``stats`` maps op name → its merged result (markdup counts,
+        per-RG stats, pileup coverage...)."""
+        from disq_tpu.runtime.oppipe import OpPipeline
+
+        pipe = ops[0] if len(ops) == 1 and isinstance(ops[0], OpPipeline) \
+            else OpPipeline(*ops)
+        res = pipe.run([self.reads])
+        header = self.header
+        if any(op.name == "sort" for op in pipe.ops):
+            header = header.with_sort_order("coordinate")
+        out = ReadsDataset(header=header, reads=res.batches[0],
+                           counters=self.counters)
+        return out, res.stats
+
 
 @dataclass
 class VariantsDataset:
@@ -573,6 +602,20 @@ class ReadsStorage:
         self._options = self._options.with_mesh(devices)
         return self
 
+    def read_filter(self, spec: str) -> "ReadsStorage":
+        """Push a ``samtools view``-style predicate + subsample into
+        the decode itself (``ops/rfilter.py``): ``"-f INT"`` require
+        flag bits, ``"-F INT"`` exclude flag bits, ``"-q INT"``
+        minimum MAPQ, ``"-s SEED.FRAC"`` keep FRAC of read names
+        (hash-seeded — mates travel together). On the resident path
+        the mask builds on device from the HBM flag/mapq columns and
+        each shard compacts BEFORE any d2h or host record parse; the
+        host path applies the bit-identical numpy mask. The spec is
+        validated here, eagerly. Env equivalent:
+        ``DISQ_TPU_READ_FILTER``."""
+        self._options = self._options.with_read_filter(spec)
+        return self
+
     def num_shards(self, n: int) -> "ReadsStorage":
         """Device-shard count override (defaults to local device count)."""
         self._num_shards = n
@@ -838,7 +881,9 @@ class ServeHandle:
 
     ``address`` is the ``host:port`` of the HTTP plane now answering
     ``POST /query/reads``, ``POST /query/variants``,
-    ``POST /query/stats``, ``POST /serve/register`` and
+    ``POST /query/stats``, the operator-suite queries
+    ``POST /query/markdup-stats`` / ``POST /query/pileup`` /
+    ``POST /query/filtered-count``, ``POST /serve/register`` and
     ``GET /serve/stats`` alongside the existing introspection
     endpoints. ``close()`` tears the daemon down (and the HTTP server,
     when :func:`serve` started it)."""

@@ -514,11 +514,40 @@ class BamSource:
         ctx,
     ) -> Tuple[ReadBatch, Tuple[int, int, int]]:
         """``_decode_fetched_inner`` under a per-split
-        ``bam.split.decode`` span carrying the shard id."""
+        ``bam.split.decode`` span carrying the shard id.
+
+        A configured read filter (``DisqOptions.read_filter`` /
+        ``DISQ_TPU_READ_FILTER``) applies HERE — inside the decode
+        stage, per shard, before any d2h or host materialization —
+        covering the resident, host, and salvage inner paths alike
+        (and the BAI traversal route, which decodes through this
+        method too)."""
         from disq_tpu.runtime.tracing import span
 
         with span("bam.split.decode", shard=ctx.shard_id):
-            return self._decode_fetched_inner(header, fetched, ctx)
+            batch, stats = self._decode_fetched_inner(header, fetched, ctx)
+            rf = self._read_filter()
+            if rf is not None and batch.count:
+                from disq_tpu.ops.rfilter import apply_read_filter
+
+                batch = apply_read_filter(batch, rf)
+            return batch, stats
+
+    def _read_filter(self):
+        """The storage's parsed ``ReadFilter``, or None — the operator
+        module is only imported once a spec is actually set (the
+        suite-off zero-work guard)."""
+        import os
+
+        opts = getattr(self._storage, "_options", None)
+        spec = getattr(opts, "read_filter", None) if opts else None
+        if spec is None:
+            spec = os.environ.get("DISQ_TPU_READ_FILTER") or None
+        if not spec:
+            return None
+        from disq_tpu.ops.rfilter import parse_read_filter
+
+        return parse_read_filter(spec)
 
     def _decode_fetched_inner(
         self,

@@ -179,6 +179,10 @@ class ColumnarBatch:
         self._rb: Optional[ReadBatch] = None
         self._hbm = 0
         self._released = False
+        # True when this batch is the sole owner of its record blob
+        # (a compacted filter result): in-place byte patches
+        # (``or_flags``) may skip the copy-on-write
+        self._blob_owned = False
         # lazy state is shared across threads (writer pipeline workers
         # slice the same dataset batch concurrently): the lock makes
         # each lazy build/fetch happen once — unlocked, W workers
@@ -378,6 +382,7 @@ class ColumnarBatch:
             with self._lock:
                 if self._ragged_rb is None:
                     from disq_tpu.bam.codec import decode_records
+                    from disq_tpu.runtime.tracing import counter
 
                     rb = decode_records(
                         self._host_blob(), self._offsets,
@@ -385,6 +390,9 @@ class ColumnarBatch:
                     if self._order is not None:
                         rb = rb.take(self._order)
                     self._ragged_rb = rb
+                    # the operator-suite resident-leg witness: a fully
+                    # resident chain never host-parses records
+                    counter("columnar.batch.materializations").inc()
         return self._ragged_rb
 
     def __getattr__(self, name: str):
@@ -435,8 +443,117 @@ class ColumnarBatch:
     def take(self, indices: np.ndarray) -> ReadBatch:
         return self.to_read_batch().take(indices)
 
-    def filter(self, mask: np.ndarray) -> ReadBatch:
-        return self.to_read_batch().filter(mask)
+    def filter(self, mask: np.ndarray) -> "ReadBatch | ColumnarBatch":
+        """Keep records where ``mask`` is true. Device-backed batches
+        compact ON DEVICE (operator-suite tentpole a): the fixed
+        columns are gathered by the kept indices in HBM — records the
+        mask drops never cross d2h — and the host record blob is
+        compacted by one vectorized segment gather, so the result is a
+        self-contained device-backed batch (``_order`` folded away:
+        concat / pickle / encode_source all see a plain source-order
+        blob). Host-backed batches materialize as before."""
+        mask = np.asarray(mask)
+        if self._dev_snapshot() is None or self._offsets is None:
+            return self.to_read_batch().filter(mask)
+        return self._compact_device(np.nonzero(mask)[0])
+
+    def _compact_device(self, keep: np.ndarray) -> "ReadBatch | ColumnarBatch":
+        """Device compaction gather behind ``filter``: ``keep`` holds
+        the kept logical indices, ascending."""
+        from disq_tpu.bam.columnar import segment_gather
+        from disq_tpu.runtime.tracing import (
+            count_transfer, span, track_hbm)
+
+        dev = self._dev_snapshot()
+        keep = np.asarray(keep, dtype=np.int64)
+        k = len(keep)
+        if k == 0:
+            return ColumnarBatch.from_host(ReadBatch.empty())
+        with span("columnar.batch.compact", records=self._n, kept=k):
+            # host blob compaction: gather the kept records' byte
+            # spans into a fresh contiguous blob (logical -> blob
+            # record index via any pending permutation)
+            src = self._order[keep] if self._order is not None else keep
+            new_blob, new_off = segment_gather(
+                self._host_blob(), self._offsets, src)
+            fns = _jax_fns()
+            jnp = fns["jnp"]
+            pad = _bucket_n(k) - k
+            idx_host = np.empty(k + pad, np.int32)
+            idx_host[:k] = keep
+            idx_host[k:] = keep[-1]
+            count_transfer("h2d", idx_host.nbytes)
+            idx = jnp.asarray(idx_host)
+            out = ColumnarBatch.__new__(ColumnarBatch)
+            ColumnarBatch.__init__(out)
+            out._n = k
+            out._n_ref = self._n_ref
+            out._dev = {name: dev[name][idx] for name in FIXED_COLUMNS}
+            if self._mesh is not None:
+                from disq_tpu.runtime.mesh import mesh_put
+
+                out._dev = {name: mesh_put(col, self._mesh)
+                            for name, col in out._dev.items()}
+                out._mesh = self._mesh
+            out._blob = new_blob
+            out._offsets = new_off
+            out._blob_owned = True
+            out._hbm = len(out._dev) * (k + pad) * 4
+            track_hbm(out._hbm)
+            _note_build(out._hbm)
+        return out
+
+    def or_flags(self, mask: np.ndarray, bits: int = 0x400) -> None:
+        """OR ``bits`` into the flag of every record where ``mask`` is
+        true — duplicate marking's write-back. Three synchronized
+        views update: the resident flag column (in HBM, one small mask
+        upload), the host record blob's flag bytes (copy-on-write
+        unless this batch owns its blob), and any host caches (dropped
+        so the next fetch re-derives). The blob patch is what makes
+        the resident write path's output byte-identical to a
+        host-marked file."""
+        idx = np.nonzero(np.asarray(mask))[0]
+        if len(idx) == 0:
+            return
+        lo_b, hi_b = bits & 0xFF, (bits >> 8) & 0xFF
+        with self._lock:
+            if self._offsets is not None:
+                blob = self._host_blob()
+                if not self._blob_owned:
+                    blob = blob.copy()
+                    self._blob_owned = True
+                src = (self._order[idx]
+                       if self._order is not None else idx)
+                off = self._offsets[src]
+                if lo_b:
+                    blob[off + 18] |= np.uint8(lo_b)
+                if hi_b:
+                    blob[off + 19] |= np.uint8(hi_b)
+                self._blob = blob
+            dev = self._dev
+            if dev is not None:
+                from disq_tpu.runtime.tracing import count_transfer
+
+                fns = _jax_fns()
+                jnp = fns["jnp"]
+                padded = int(dev["flag"].shape[0])
+                m = np.zeros(padded, np.int32)
+                m[idx] = 1
+                count_transfer("h2d", m.nbytes)
+                new_flag = jnp.where(
+                    jnp.asarray(m) != 0, dev["flag"] | bits, dev["flag"])
+                if self._mesh is not None:
+                    from disq_tpu.runtime.mesh import mesh_put
+
+                    new_flag = mesh_put(new_flag, self._mesh)
+                dev["flag"] = new_flag
+            elif self._rb is not None:
+                self._rb.flag[idx] |= np.uint16(bits)
+            # host-side derived views are stale now
+            self._cache.pop("flag", None)
+            if self._ragged_rb is not None and self._offsets is not None:
+                self._ragged_rb = None
+                self._rb = None
 
     def slice(self, start: int, stop: int) -> ReadBatch:
         return self.to_read_batch().slice(start, stop)

@@ -244,6 +244,14 @@ class DisqOptions:
     # Env equivalent: DISQ_TPU_SLO. None (default) starts no evaluator
     # thread and touches nothing (check_overhead-guarded).
     slo: Optional[str] = None
+    # Resident read filter (ops/rfilter.py): a ``samtools view``-style
+    # spec ("-f INT -F INT -q INT -s SEED.FRAC") pushed into the
+    # decode — the mask builds on device from the resident flag/mapq
+    # columns and compacts each shard BEFORE any d2h or host record
+    # parse. Env equivalent: DISQ_TPU_READ_FILTER. None (default)
+    # builds no mask and imports no operator module
+    # (check_overhead-guarded).
+    read_filter: Optional[str] = None
 
     def with_policy(self, policy: "ErrorPolicy | str") -> "DisqOptions":
         return replace(self, error_policy=ErrorPolicy.coerce(policy))
@@ -362,6 +370,15 @@ class DisqOptions:
 
     def with_device_deflate(self, enable: bool = True) -> "DisqOptions":
         return replace(self, device_deflate=bool(enable))
+
+    def with_read_filter(self, spec: str) -> "DisqOptions":
+        """Push a ``samtools view``-grammar read filter into the
+        decode (validated eagerly so a typo fails at options-build
+        time, not per shard)."""
+        from disq_tpu.ops.rfilter import parse_read_filter
+
+        parse_read_filter(spec)  # raises ValueError on a malformed spec
+        return replace(self, read_filter=str(spec))
 
     def with_mesh(self, devices: int = 0) -> "DisqOptions":
         """Arm the mesh-native pipeline: 0 = all local devices, n = the

@@ -9,7 +9,10 @@ path measured in p50/p99 latency under concurrency:
 
 - **Endpoints** ride the existing introspection HTTP plane
   (``runtime/introspect.py``): ``POST /query/reads``,
-  ``POST /query/variants``, ``POST /query/stats``,
+  ``POST /query/variants``, ``POST /query/stats``, the operator-suite
+  queries ``POST /query/markdup-stats`` / ``POST /query/pileup`` /
+  ``POST /query/filtered-count`` (``ops/markdup.py`` / ``ops/pileup.py``
+  / ``ops/rfilter.py`` over the parsed tier),
   ``GET /serve/stats``, ``GET /serve/cachemap`` (the cache-locality
   digest the fleet router in ``runtime/fleet.py`` consumes) and
   ``POST /serve/register`` all funnel through
@@ -897,6 +900,89 @@ class ServeDaemon:
             }
         return out
 
+    # -- operator-suite queries (ops/*.py on the parsed tier) --------------
+
+    def _q_markdup_stats(self, doc: Dict[str, Any],
+                         tenant: str) -> Dict[str, Any]:
+        """``POST /query/markdup-stats`` — duplicate-marking stats for
+        the reads overlapping ``intervals`` (``ops/markdup.py`` on the
+        hot-block cache's parsed tier; the batch under the query is one
+        coordinate scan, so no seam merge is needed). Optional
+        ``"rgstats": true`` adds the per-read-group breakdown of the
+        marked batch."""
+        from disq_tpu.ops.markdup import markdup_batch
+
+        ds = self._dataset(doc, "reads")
+        if ds.kind != "reads":
+            raise ValueError("/query/markdup-stats serves reads datasets")
+        intervals = self._parse_intervals(doc)
+        _header, batch, count = self._read_batch(ds, intervals, tenant)
+        batch, res = markdup_batch(batch)
+        out: Dict[str, Any] = {"dataset": ds.name, "count": count,
+                               "markdup": res.stats()}
+        if doc.get("rgstats"):
+            from disq_tpu.ops.rgstats import read_group_stats
+
+            out["rgstats"] = read_group_stats(batch)
+        return out
+
+    def _q_pileup(self, doc: Dict[str, Any], tenant: str) -> Dict[str, Any]:
+        """``POST /query/pileup`` — per-base coverage over ONE interval
+        (``ops/pileup.py``). The full base vector is returned up to
+        ``max_bases`` (default 16384) positions; wider regions get the
+        summary only."""
+        from disq_tpu.ops.pileup import region_pileup
+
+        ds = self._dataset(doc, "reads")
+        if ds.kind != "reads":
+            raise ValueError("/query/pileup serves reads datasets")
+        intervals = self._parse_intervals(doc)
+        if len(intervals) != 1:
+            raise ValueError("/query/pileup wants exactly one interval")
+        iv = intervals[0]
+        header, batch, _count = self._read_batch(ds, [iv], tenant)
+        names = [s.name for s in header.sequences]
+        if iv.contig not in names:
+            raise ValueError(f"unknown contig {iv.contig!r}")
+        start, end = int(iv.start) - 1, int(iv.end)
+        cov = region_pileup(batch, names.index(iv.contig), start, end)
+        out: Dict[str, Any] = {
+            "dataset": ds.name, "contig": iv.contig,
+            "start": int(iv.start), "end": int(iv.end),
+            "max": int(cov.max()) if len(cov) else 0,
+            "mean": round(float(cov.mean()), 4) if len(cov) else 0.0,
+            "nonzero": int((cov > 0).sum()),
+        }
+        if len(cov) <= int(doc.get("max_bases", 16384)):
+            out["coverage"] = cov.astype(int).tolist()
+        return out
+
+    def _q_filtered_count(self, doc: Dict[str, Any],
+                          tenant: str) -> Dict[str, Any]:
+        """``POST /query/filtered-count`` — how many reads in
+        ``intervals`` pass a ``samtools view``-grammar ``"filter"``
+        spec (``ops/rfilter.py``), without materializing records into
+        the response."""
+        import numpy as np
+
+        from disq_tpu.ops.rfilter import (
+            host_mask, name_hashes_from_columns, parse_read_filter)
+
+        ds = self._dataset(doc, "reads")
+        if ds.kind != "reads":
+            raise ValueError("/query/filtered-count serves reads datasets")
+        rf = parse_read_filter(str(doc.get("filter", "")))
+        intervals = self._parse_intervals(doc)
+        _header, batch, count = self._read_batch(ds, intervals, tenant)
+        nh = None
+        if rf.needs_name_hash:
+            nh = name_hashes_from_columns(
+                np.asarray(batch.names), np.asarray(batch.name_offsets))
+        mask = host_mask(rf, np.asarray(batch.flag),
+                         np.asarray(batch.mapq), nh)
+        return {"dataset": ds.name, "count": count,
+                "matched": int(mask.sum())}
+
     # -- stats + HTTP ------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
@@ -923,6 +1009,9 @@ class ServeDaemon:
         "/query/reads": "_q_reads",
         "/query/variants": "_q_variants",
         "/query/stats": "_q_stats",
+        "/query/markdup-stats": "_q_markdup_stats",
+        "/query/pileup": "_q_pileup",
+        "/query/filtered-count": "_q_filtered_count",
     }
 
     def cachemap(self, doc: Dict[str, Any]) -> Dict[str, Any]:

@@ -294,6 +294,62 @@ def resident_leg(path, baseline) -> str:
     return ""
 
 
+def ops_leg(path, baseline) -> str:
+    """--ops leg: the chained operator pipeline (filter → sort →
+    markdup → pileup → rgstats, ``runtime/oppipe.py``) read through a
+    transient-fault schedule must produce stats — and marked flag
+    columns — identical to the same chain over a fault-free read.
+    Duplicate marking is the sharpest probe here: a retried/salvaged
+    shard that dropped or reordered records would shift the
+    (refid, unclipped-pos, orientation) groups and change the count."""
+    import numpy as np
+
+    from disq_tpu import DisqOptions, ReadsStorage
+    from disq_tpu.fsw import (
+        FaultInjectingFileSystemWrapper,
+        FaultSpec,
+        PosixFileSystemWrapper,
+        register_filesystem,
+    )
+
+    chain = (("filter", "-F 0x800"), "sort", "markdup",
+             ("pileup", 0, 0, 10_000), "rgstats")
+    faults = [
+        FaultSpec(kind="transient", probability=0.08),
+        FaultSpec(kind="truncate", probability=0.04, truncate_bytes=80),
+    ]
+    register_filesystem("fault", FaultInjectingFileSystemWrapper(
+        PosixFileSystemWrapper(), faults, seed=2424))
+    opts = DisqOptions(max_retries=8, retry_backoff_s=0.0,
+                       executor_workers=2, resident_decode=True)
+    try:
+        ds = (ReadsStorage.make_default().split_size(SPLIT)
+              .options(opts).read("fault://" + path))
+        got_ds, got = ds.pipeline(*chain)
+        # fault-free host-path truth: a fresh read (NOT `baseline` —
+        # markdup patches 0x400 into the batch it is handed)
+        want_src = ReadsStorage.make_default().split_size(SPLIT).read(path)
+        want_ds, want = want_src.pipeline(*chain)
+    except Exception as e:  # noqa: BLE001 — any escape is a failure
+        return f"ops: {type(e).__name__}: {e}"
+    got_cov = got.get("pileup", {}).pop("coverage", None)
+    want_cov = want.get("pileup", {}).pop("coverage", None)
+    if not np.array_equal(got_cov, want_cov):
+        return "ops: pileup coverage differs from the fault-free chain"
+    if got != want:
+        return (f"ops: chained stats differ from the fault-free chain "
+                f"(got {got}, want {want})")
+    if got_ds.count() != want_ds.count():
+        return (f"ops: {got_ds.count()} records != fault-free "
+                f"{want_ds.count()}")
+    if not np.array_equal(np.asarray(got_ds.reads.flag),
+                          np.asarray(want_ds.reads.flag)):
+        return "ops: marked flag column differs from the fault-free chain"
+    if hasattr(got_ds.reads, "release"):
+        got_ds.reads.release()
+    return ""
+
+
 def device_write_leg(path, baseline) -> str:
     """--device-write leg: the symmetric device write path
     (service-routed SIMD deflate + resident encode) under injected
@@ -1448,6 +1504,12 @@ def main(argv=None) -> int:
                          "fault schedule must yield a device-backed "
                          "batch byte-identical (after d2h) to the "
                          "fault-free host path")
+    ap.add_argument("--ops", action="store_true",
+                    help="run the operator-suite leg: the chained "
+                         "filter → sort → markdup → pileup → rgstats "
+                         "pipeline through a transient-fault schedule "
+                         "must produce stats and marked flag columns "
+                         "identical to the fault-free chain")
     ap.add_argument("--device-write", action="store_true",
                     help="run the symmetric device write leg: a "
                          "resident-encoded, service-routed SIMD-deflate "
@@ -1538,6 +1600,11 @@ def main(argv=None) -> int:
         if args.resident:
             err = resident_leg(path, baseline)
             print(f"[resident] {'ok' if not err else 'FAIL: ' + err}")
+            if err:
+                failures.append((args.seed, err))
+        if args.ops:
+            err = ops_leg(path, baseline)
+            print(f"[ops] {'ok' if not err else 'FAIL: ' + err}")
             if err:
                 failures.append((args.seed, err))
         if args.device_write:

@@ -76,6 +76,11 @@ ALLOWED_PREFIXES = {
     # decisions, cross-replica hedge accounting, fleet-wide admission,
     # replica liveness gauge and cachemap refresh spans.
     "fleet",
+    # Resident operator suite (runtime/oppipe.py + ops/{rfilter,
+    # markdup,pileup,rgstats}.py): per-operator apply spans, filter
+    # in/kept counters, duplicate + boundary-flip counters, pileup
+    # record counter and the chained-pipeline run span.
+    "ops",
 }
 
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")

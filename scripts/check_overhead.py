@@ -326,6 +326,45 @@ def main() -> int:
             "DisqOptions.slo configured — the default path must start "
             "no disq-slo thread")
 
+    # -- 1f. operator suite: off ⇒ no masks, no operator imports -------------
+    # The resident operator chain (runtime/oppipe.py + ops/{rfilter,
+    # markdup,pileup,rgstats}.py) is pay-for-what-you-chain: with no
+    # read_filter configured and no pipeline() call, the decode path
+    # must build no mask, import no operator module and count nothing.
+    if os.environ.get("DISQ_TPU_READ_FILTER"):
+        errors.append(
+            "DISQ_TPU_READ_FILTER leaked into the guard's env — the "
+            "default decode must compact nothing")
+    if DisqOptions().read_filter is not None:
+        errors.append(
+            "DisqOptions().read_filter is not None by default — every "
+            "decode would parse a filter spec")
+    from disq_tpu.bam.source import BamSource
+
+    class _FilterlessSource(BamSource):
+        def __init__(self):  # probe _read_filter without opening a file
+            self._storage = _Storage()
+
+    if _FilterlessSource()._read_filter() is not None:
+        errors.append(
+            "BamSource._read_filter() built a filter with no spec "
+            "configured — the default decode would mask every shard")
+    op_mods = [m for m in sys.modules
+               if m == "disq_tpu.runtime.oppipe"
+               or m in ("disq_tpu.ops.rfilter", "disq_tpu.ops.markdup",
+                        "disq_tpu.ops.pileup", "disq_tpu.ops.rgstats")]
+    if op_mods:
+        errors.append(
+            f"operator modules imported on the suite-off path: "
+            f"{op_mods} — filter/markdup/pileup/rgstats must load only "
+            "behind a spec, a pipeline() call or a /query/* endpoint")
+    for name in ("ops.filter.records_in", "ops.markdup.duplicates",
+                 "ops.pileup.records"):
+        if REGISTRY.counter(name).total() != 0:
+            errors.append(
+                f"{name} is nonzero on the suite-off path — no operator "
+                "may examine records by default")
+
     # -- 2. timing: per-shard inline-executor overhead -----------------------
     sink = []
 

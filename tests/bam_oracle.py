@@ -248,3 +248,195 @@ def synth_records(
         recs.append(ORecord(name=f"unm{i}", refid=-1, pos=-1, flag=4,
                             seq="ACGT", qual=b"\x10\x10\x10\x10", bin=4680))
     return recs
+
+
+def synth_paired_records(
+    n_pairs: int,
+    refs: List[Tuple[str, int]] = None,
+    seed: int = 0,
+    dup_every: int = 5,
+    rg_names: Tuple[str, ...] = ("rg1", "rg2"),
+) -> List[ORecord]:
+    """Coordinate-sorted paired reads with controlled duplicate
+    clusters for the operator-suite golden tests: every ``dup_every``-th
+    pair gets 1-2 extra copies at the same *unclipped* 5' position
+    (some with a leading soft-clip, so pos differs but the key
+    matches), plus excluded-category members (unmapped / secondary /
+    supplementary) sitting inside clusters, and round-robin ``RG:Z``
+    tags."""
+    refs = refs or DEFAULT_REFS
+    rng = np.random.default_rng(seed)
+    recs: List[ORecord] = []
+
+    def one(name, refid, pos, flag, clip=0, rl=60, q_base=25, rg=None):
+        cigar = ([(clip, "S")] if clip else []) + [(rl - clip, "M")]
+        if flag & 4:
+            cigar = []  # placed-unmapped: coordinates but no alignment
+        r = ORecord(
+            name=name, refid=refid, pos=pos + clip if clip else pos,
+            mapq=int(rng.integers(10, 60)), flag=flag, cigar=cigar,
+            seq="".join(rng.choice(list("ACGT"), rl)),
+            qual=bytes(rng.integers(q_base, q_base + 15, rl,
+                                    dtype=np.uint8).tolist()),
+            tags=(b"RGZ" + rg.encode() + b"\x00") if rg else b"",
+        )
+        r.bin = reg2bin(max(r.pos, 0), max(r.pos, 0) + max(ref_span(r), 1))
+        return r
+
+    for p in range(n_pairs):
+        refid = int(rng.integers(0, len(refs)))
+        rl = 60
+        pos1 = int(rng.integers(100, refs[refid][1] - 1000))
+        pos2 = pos1 + int(rng.integers(80, 400))
+        rg = rg_names[p % len(rg_names)] if rg_names else None
+        # proper pair: R1 forward, R2 reverse
+        recs.append(one(f"p{p:05d}", refid, pos1,
+                        0x1 | 0x2 | 0x20 | 0x40, rg=rg))
+        recs.append(one(f"p{p:05d}", refid, pos2,
+                        0x1 | 0x2 | 0x10 | 0x80, rg=rg))
+        if p % dup_every == 0:
+            # duplicate copies of R1's 5' site: one plain, one whose
+            # leading soft-clip shifts pos but not the unclipped key
+            recs.append(one(f"d{p:05d}a", refid, pos1,
+                            0x1 | 0x2 | 0x20 | 0x40, q_base=32, rg=rg))
+            recs.append(one(f"d{p:05d}b", refid, pos1, 0x1 | 0x40,
+                            clip=7, q_base=18, rg=rg))
+        if p % 11 == 0:
+            # excluded categories inside the cluster: none may mark or
+            # be marked (unmapped-at-pos, secondary, supplementary)
+            recs.append(one(f"x{p:05d}u", refid, pos1, 0x4 | 0x1 | 0x40))
+            recs.append(one(f"x{p:05d}s", refid, pos1, 0x100, rg=rg))
+            recs.append(one(f"x{p:05d}v", refid, pos1, 0x800, rg=rg))
+    recs.sort(key=lambda r: (r.refid if r.refid >= 0 else 1 << 30, r.pos))
+    return recs
+
+
+# -- operator-suite oracles (sequential, record-at-a-time) ------------------
+
+MARKDUP_EXCLUDE_O = 0x4 | 0x100 | 0x800
+
+
+def _o_clips(rec: ORecord) -> Tuple[int, int]:
+    """(leading, trailing) clipped bases — H then S at the start,
+    S then H at the end, per the SAM spec's legal clip placement."""
+    lead = trail = 0
+    cig = list(rec.cigar)
+    for _ in range(2):
+        if cig and cig[0][1] in "HS":
+            lead += cig[0][0]
+            cig = cig[1:]
+    for _ in range(2):
+        if cig and cig[-1][1] in "HS":
+            trail += cig[-1][0]
+            cig = cig[:-1]
+    return lead, trail
+
+
+def o_markdup_key(rec: ORecord):
+    """(refid, unclipped 5' pos, orientation) or None if excluded."""
+    if rec.flag & MARKDUP_EXCLUDE_O or rec.refid < 0:
+        return None
+    lead, trail = _o_clips(rec)
+    span = max(ref_span(rec), 1)
+    if rec.flag & 0x10:
+        return (rec.refid, rec.pos + span - 1 + trail, 1)
+    return (rec.refid, rec.pos - lead, 0)
+
+
+def o_markdup_score(rec: ORecord) -> int:
+    q = rec.qual if rec.qual is not None else b""
+    return sum(v for v in q if 15 <= v != 0xFF)
+
+
+def oracle_markdup(records: List[ORecord]) -> List[bool]:
+    """Duplicate flags over the WHOLE record list (global truth — what
+    the per-shard device pass plus the boundary merge must equal):
+    group by key, keep the best score (ties: earliest record), mark
+    the rest."""
+    groups = {}
+    for i, rec in enumerate(records):
+        k = o_markdup_key(rec)
+        if k is not None:
+            groups.setdefault(k, []).append(i)
+    dup = [False] * len(records)
+    for idxs in groups.values():
+        best = max(idxs, key=lambda i: (o_markdup_score(records[i]), -i))
+        for i in idxs:
+            dup[i] = i != best
+    return dup
+
+
+def oracle_pileup(records: List[ORecord], refid: int, start: int,
+                  end: int) -> np.ndarray:
+    """Per-base coverage of [start, end): mapped records only, one
+    count per reference base the alignment spans."""
+    cov = np.zeros(max(0, end - start), np.int64)
+    for rec in records:
+        if rec.flag & 0x4 or rec.refid != refid:
+            continue
+        span = max(ref_span(rec), 1)
+        lo, hi = max(rec.pos, start), min(rec.pos + span, end)
+        if lo < hi:
+            cov[lo - start: hi - start] += 1
+    return cov
+
+
+def o_read_group(rec: ORecord):
+    """The RG:Z value via a sequential struct tag walk, or None."""
+    buf, s, e = rec.tags, 0, len(rec.tags)
+    sizes = {"A": 1, "c": 1, "C": 1, "s": 2, "S": 2, "i": 4, "I": 4,
+             "f": 4}
+    while s + 3 <= e:
+        tag, tp = buf[s:s + 2], chr(buf[s + 2])
+        s += 3
+        if tp in "ZH":
+            z = buf.index(b"\x00", s)
+            if tag == b"RG" and tp == "Z":
+                return buf[s:z].decode()
+            s = z + 1
+        elif tp == "B":
+            sub = chr(buf[s])
+            (cnt,) = struct.unpack_from("<i", buf, s + 1)
+            s += 5 + sizes.get(sub, 1) * cnt
+        else:
+            s += sizes.get(tp, 1)
+    return None
+
+
+def oracle_rgstats(records: List[ORecord]) -> dict:
+    """{rg: {reads, duplicates, dup_rate, mean_mapq, mapq_hist}} with
+    untagged reads in a trailing "(none)" group — the shape
+    ``ops/rgstats.read_group_stats`` returns."""
+    order: List[str] = []
+    hist = {}
+    dups = {}
+    saw_none = False
+    for rec in records:
+        rg = o_read_group(rec)
+        if rg is None:
+            rg = "(none)"
+            saw_none = True
+        if rg not in hist:
+            if rg != "(none)":
+                order.append(rg)
+            hist[rg] = np.zeros(256, np.int64)
+            dups[rg] = 0
+        hist[rg][rec.mapq] += 1
+        dups[rg] += (rec.flag >> 10) & 1
+    if saw_none or not order:
+        order.append("(none)")
+        hist.setdefault("(none)", np.zeros(256, np.int64))
+        dups.setdefault("(none)", 0)
+    out = {}
+    mq = np.arange(256)
+    for rg in order:
+        h = hist[rg]
+        reads, d = int(h.sum()), int(dups[rg])
+        out[rg] = {
+            "reads": reads, "duplicates": d,
+            "dup_rate": round(d / reads, 6) if reads else 0.0,
+            "mean_mapq": round(float((h * mq).sum() / reads), 3)
+            if reads else 0.0,
+            "mapq_hist": h.astype(int).tolist(),
+        }
+    return out

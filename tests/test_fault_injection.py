@@ -305,7 +305,10 @@ def test_chaos_soak_smoke(executor_workers):
     SIGKILLed mid-storm: a hedged request stitches into one trace
     across router + both replicas, fleet.replica_lost lands in the
     flight recorder, and every response stays digest-identical to the
-    dead replica's pre-storm truth)."""
+    dead replica's pre-storm truth), and --ops (the chained
+    filter → sort → markdup → pileup → rgstats pipeline through a
+    transient-fault schedule: stats and marked flag columns must be
+    identical to the fault-free chain)."""
     script = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "scripts", "chaos_soak.py")
@@ -314,7 +317,8 @@ def test_chaos_soak_smoke(executor_workers):
          "--seed", "7", "--executor-workers", str(executor_workers),
          "--writer-workers", str(executor_workers),
          "--hedge", "--breaker", "--resident", "--device-write",
-         "--steal", "--kill", "--coord-kill", "--serve", "--fleet"]
+         "--steal", "--kill", "--coord-kill", "--serve", "--fleet",
+         "--ops"]
         + (["--watchdog"] if executor_workers > 1 else []),
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},

@@ -17,6 +17,12 @@ from disq_tpu import BaiWriteOption, ReadsStorage, SbiWriteOption, TraversalPara
 from disq_tpu.api import Interval
 from disq_tpu.runtime import serve as serve_mod
 from disq_tpu.runtime.introspect import stop_introspect_server
+from disq_tpu.runtime.tracing import (
+    TRACE_ID_HEADER,
+    TRACE_PARENT_HEADER,
+    TRACE_TENANT_HEADER,
+    spans,
+)
 
 from tests.bam_oracle import DEFAULT_REFS, make_bam_bytes, synth_records
 
@@ -411,3 +417,130 @@ class TestConcurrencyIdentity:
                                timeout=300)
         finally:
             device_service.shutdown_service()
+
+
+class TestOperatorEndpoints:
+    """Satellite: the operator-suite endpoints (``/query/pileup``,
+    ``/query/markdup-stats``, ``/query/filtered-count``) are
+    first-class serve citizens — answers match the host oracles,
+    per-tenant admission counts them, and request tracing stitches
+    their operator spans under the ``serve.request.trace`` root."""
+
+    def test_markdup_stats_and_filtered_count_shape(self, daemon):
+        _, addr = daemon
+        contig, start, end = REGIONS[1]
+        _, reads = _post(addr, "/query/reads",
+                         _q(contig, start, end, limit=0, digest=False))
+        status, md = _post(addr, "/query/markdup-stats",
+                           _q(contig, start, end, rgstats=True))
+        assert status == 200
+        assert md["count"] == reads["count"]
+        assert md["markdup"]["examined"] <= md["count"]
+        assert md["markdup"]["duplicates"] <= md["markdup"]["examined"]
+        assert sum(g["reads"] for g in md["rgstats"].values()) \
+            == md["count"]
+        # a spec and its complement partition the batch exactly
+        _, hit = _post(addr, "/query/filtered-count",
+                       _q(contig, start, end, filter="-f 0x10"))
+        _, miss = _post(addr, "/query/filtered-count",
+                        _q(contig, start, end, filter="-F 0x10"))
+        assert hit["matched"] + miss["matched"] == reads["count"]
+        # malformed grammar is a client error, not a 500
+        status, err = _post(addr, "/query/filtered-count",
+                            _q(contig, start, end, filter="-z oops"))
+        assert status == 400 and "error" in err
+
+    def test_pileup_matches_host_oracle(self, daemon):
+        from tests.bam_oracle import oracle_pileup
+
+        _, addr = daemon
+        contig, start, end = REGIONS[0]  # chr1 — refid 0, 5000 bp
+        status, out = _post(addr, "/query/pileup", _q(contig, start, end))
+        assert status == 200
+        truth = oracle_pileup(
+            synth_records(1500, seed=23, unmapped_tail=0),
+            0, start - 1, end)
+        assert out["coverage"] == truth.astype(int).tolist()
+        assert out["max"] == int(truth.max())
+        assert out["nonzero"] == int((truth > 0).sum())
+        # summary-only once the region outgrows max_bases
+        status, slim = _post(addr, "/query/pileup",
+                             _q(contig, start, end, max_bases=16))
+        assert status == 200 and "coverage" not in slim
+        assert slim["max"] == out["max"]
+        # exactly one interval, like samtools mpileup -r
+        doc = _q(contig, start, end)
+        doc["intervals"].append(
+            {"contig": "chr2", "start": 1, "end": 10})
+        status, err = _post(addr, "/query/pileup", doc)
+        assert status == 400 and "error" in err
+
+    def test_admission_counts_operator_queries(self, daemon):
+        d, addr = daemon
+        adm = d.admission
+        for _ in range(8):
+            adm.acquire("pig")
+
+        def parked():
+            try:
+                adm.acquire("pig")
+            except serve_mod.AdmissionShed:
+                return
+            adm.release("pig")
+
+        waiters = [threading.Thread(target=parked) for _ in range(32)]
+        for t in waiters:
+            t.start()
+        spins = 500
+        while spins and adm.stats()["tenants"]["pig"]["queued"] < 32:
+            spins -= 1
+            threading.Event().wait(0.01)
+        try:
+            for path, doc in [
+                ("/query/pileup", _q(*REGIONS[0], tenant="pig")),
+                ("/query/markdup-stats", _q(*REGIONS[0], tenant="pig")),
+            ]:
+                status, out = _post(addr, path, doc)
+                assert status == 429, (path, out)
+                assert out["tenant"] == "pig"
+            # an unpinned tenant still gets operator answers
+            status, _ = _post(addr, "/query/pileup",
+                              _q(*REGIONS[0], tenant="calm"))
+            assert status == 200
+        finally:
+            for _ in range(8):
+                adm.release("pig")
+            for t in waiters:
+                t.join(timeout=30)
+
+    def test_operator_spans_stitch_under_request_root(self, daemon):
+        """A traced request to an operator endpoint leaves a
+        ``serve.request.trace`` root AND operator spans carrying the
+        same trace id, so ``trace_report --request`` renders the
+        filter/markdup/pileup work inside the request waterfall."""
+        _, addr = daemon
+        for trace_id, path, doc, op_span in [
+            ("beefcafe00000021", "/query/pileup",
+             _q(*REGIONS[0], tenant="acme"), "ops.pileup.apply"),
+            ("beefcafe00000022", "/query/markdup-stats",
+             _q(*REGIONS[1], tenant="acme"), "ops.markdup.apply"),
+        ]:
+            req = urllib.request.Request(
+                f"http://{addr}{path}", data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json",
+                         TRACE_ID_HEADER: trace_id,
+                         TRACE_PARENT_HEADER: "00",
+                         TRACE_TENANT_HEADER: "acme"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+            roots = [s for s in spans()
+                     if s["name"] == "serve.request.trace"
+                     and s.get("trace") == trace_id]
+            assert roots, f"no request root for {path}"
+            assert roots[-1]["labels"]["status"] == 200
+            assert roots[-1]["labels"]["endpoint"] == path.rsplit("/", 1)[-1]
+            assert roots[-1]["tenant"] == "acme"
+            ops = [s for s in spans()
+                   if s["name"] == op_span and s.get("trace") == trace_id]
+            assert ops, f"{op_span} not stitched into trace {trace_id}"
